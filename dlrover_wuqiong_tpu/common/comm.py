@@ -1,0 +1,196 @@
+"""Framed-message TCP RPC used for the agent↔master control plane.
+
+Parity: reference gRPC service with a generic ``get``/``report`` envelope
+(`dlrover/proto/elastic_training.proto:26-28`, `master/servicer.py:71-296`,
+`elastic_agent/master_client.py`).  The transport here is a length-prefixed
+JSON protocol over TCP — dependency-free, testable in-process, and the payloads
+are the typed messages from `messages.py`.
+
+Wire format per frame: 4-byte big-endian length + JSON body
+  request:  {"verb": "get"|"report", "node_id": int, "node_type": str,
+             "payload": <encoded message>}
+  response: {"ok": bool, "error": str, "payload": <encoded message|null>}
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from . import serialize
+from .log import get_logger
+
+logger = get_logger("comm")
+
+_LEN = struct.Struct(">I")
+MAX_FRAME = 512 * 1024 * 1024
+
+
+def _send_frame(sock: socket.socket, data: bytes):
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed connection")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> bytes:
+    (length,) = _LEN.unpack(_recv_exact(sock, 4))
+    if length > MAX_FRAME:
+        raise ValueError(f"frame too large: {length}")
+    return _recv_exact(sock, length)
+
+
+def find_free_port(host: str = "127.0.0.1") -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+def addr_connectable(addr: str, timeout: float = 1.0) -> bool:
+    """Reference `elastic_run.py:326 _check_to_use_dlrover_run` telnet probe."""
+    try:
+        host, port = addr.rsplit(":", 1)
+        with socket.create_connection((host, int(port)), timeout=timeout):
+            return True
+    except OSError:
+        return False
+
+
+class RpcServer:
+    """Threaded RPC server dispatching to a handler.
+
+    handler(verb: str, node_id: int, node_type: str, payload) -> response message
+    """
+
+    def __init__(self, handler: Callable, host: str = "0.0.0.0", port: int = 0):
+        self._handler = handler
+
+        outer = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                sock = self.request
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                while True:
+                    try:
+                        frame = _recv_frame(sock)
+                    except (ConnectionError, OSError):
+                        return
+                    try:
+                        req = serialize.loads(frame)
+                        resp = outer._handler(
+                            req.get("verb", "get"),
+                            req.get("node_id", -1),
+                            req.get("node_type", ""),
+                            req.get("payload"),
+                        )
+                        body = serialize.dumps(
+                            {"ok": True, "error": "", "payload": resp}
+                        )
+                    except Exception as e:  # noqa: BLE001 — report to caller
+                        logger.exception("rpc handler error")
+                        body = serialize.dumps(
+                            {"ok": False, "error": f"{type(e).__name__}: {e}",
+                             "payload": None}
+                        )
+                    try:
+                        _send_frame(sock, body)
+                    except OSError:
+                        return
+
+        class _Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = _Server((host, port), _Handler)
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="dwt-rpc-server"
+        )
+        self._thread.start()
+        logger.info("RPC server listening on port %s", self.port)
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class RpcError(RuntimeError):
+    pass
+
+
+class RpcClient:
+    """Persistent-connection client with retry.
+
+    Parity: reference `elastic_agent/master_client.py` retry decorator semantics.
+    """
+
+    def __init__(self, addr: str, node_id: int = -1, node_type: str = "worker",
+                 timeout: float = 30.0, retries: int = 3):
+        self._addr = addr
+        self._node_id = node_id
+        self._node_type = node_type
+        self._timeout = timeout
+        self._retries = retries
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    def _connect(self):
+        host, port = self._addr.rsplit(":", 1)
+        sock = socket.create_connection((host, int(port)), timeout=self._timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = sock
+
+    def close(self):
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                finally:
+                    self._sock = None
+
+    def _call(self, verb: str, payload: Any) -> Any:
+        req = serialize.dumps(
+            {"verb": verb, "node_id": self._node_id,
+             "node_type": self._node_type, "payload": payload}
+        )
+        last_err: Optional[Exception] = None
+        for attempt in range(self._retries):
+            try:
+                with self._lock:
+                    if self._sock is None:
+                        self._connect()
+                    _send_frame(self._sock, req)
+                    body = _recv_frame(self._sock)
+                resp = serialize.loads(body)
+                if not resp.get("ok"):
+                    raise RpcError(resp.get("error", "unknown rpc error"))
+                return resp.get("payload")
+            except RpcError:
+                raise
+            except (OSError, ConnectionError, ValueError) as e:
+                last_err = e
+                self.close()
+                time.sleep(min(2.0 ** attempt * 0.1, 2.0))
+        raise RpcError(f"rpc to {self._addr} failed after "
+                       f"{self._retries} attempts: {last_err}")
+
+    def get(self, payload: Any) -> Any:
+        return self._call("get", payload)
+
+    def report(self, payload: Any) -> Any:
+        return self._call("report", payload)

@@ -1,0 +1,75 @@
+"""Global tunables singleton.
+
+Parity: reference `dlrover/python/common/global_context.py` (Context singleton with
+master-port, relaunch policy, timeouts, `set_params_from_brain`).  Values may be
+overridden from env vars prefixed ``DWT_CTX_``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field, fields
+
+
+@dataclass
+class Context:
+    master_port: int = 0
+    node_heartbeat_interval: float = 15.0
+    node_heartbeat_timeout: float = 300.0
+    relaunch_always: bool = False
+    max_relaunch_count: int = 3
+    relaunch_on_worker_failure: int = 3
+    seconds_to_wait_pending_pod: float = 900.0
+    seconds_interval_to_optimize: float = 300.0
+    train_speed_record_num: int = 50
+    hang_detection_seconds: float = 1800.0
+    rdzv_join_timeout: float = 600.0
+    network_check: bool = False
+    auto_tunning: bool = False
+    checkpoint_replica: int = 0
+    # paths
+    work_dir: str = "/tmp/dwt"
+    extra: dict = field(default_factory=dict)
+
+    _singleton = None
+    _lock = threading.Lock()
+
+    @classmethod
+    def singleton_instance(cls) -> "Context":
+        if cls._singleton is None:
+            with cls._lock:
+                if cls._singleton is None:
+                    ctx = cls()
+                    ctx._load_env()
+                    cls._singleton = ctx
+        return cls._singleton
+
+    def _load_env(self):
+        for f in fields(self):
+            if f.name.startswith("_") or f.name == "extra":
+                continue
+            env_key = "DWT_CTX_" + f.name.upper()
+            raw = os.getenv(env_key)
+            if raw is None:
+                continue
+            if f.type in ("int", int):
+                setattr(self, f.name, int(raw))
+            elif f.type in ("float", float):
+                setattr(self, f.name, float(raw))
+            elif f.type in ("bool", bool):
+                setattr(self, f.name, raw.lower() in ("1", "true", "yes"))
+            else:
+                setattr(self, f.name, raw)
+
+    def set_params_from_optimizer(self, params: dict):
+        """Accept tuned runtime params (reference: `set_params_from_brain`)."""
+        for k, v in params.items():
+            if hasattr(self, k) and not k.startswith("_"):
+                setattr(self, k, v)
+            else:
+                self.extra[k] = v
+
+
+def get_context() -> Context:
+    return Context.singleton_instance()

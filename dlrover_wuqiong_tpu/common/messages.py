@@ -1,0 +1,300 @@
+"""Wire messages between agent and master.
+
+Parity: reference `dlrover/python/common/grpc.py:129-468` message dataclasses
+(`TaskRequest`, `Task`, `JoinRendezvousRequest`, `RendezvousState`, `NodeMeta`,
+`HeartBeat`, `ParallelConfig`, ...) and `proto/elastic_training.proto:14-29`.
+The TPU redesign replaces torch-elastic rank/world fields with the
+`jax.distributed` contract: coordinator address + process id + device counts.
+"""
+
+from __future__ import annotations
+
+from dataclasses import field
+from typing import Dict, List, Optional
+
+from .serialize import message
+
+
+@message
+class BaseRequest:
+    node_id: int = -1
+    node_type: str = ""
+
+
+@message
+class OkResponse:
+    success: bool = True
+    reason: str = ""
+
+
+# ---------------------------------------------------------------- dataset / sharding
+
+
+@message
+class DatasetShardParams:
+    batch_size: int = 0
+    num_epochs: int = 1
+    dataset_size: int = 0
+    shuffle: bool = False
+    num_minibatches_per_shard: int = 2
+    dataset_name: str = ""
+    task_type: str = "training"
+    storage_type: str = ""
+
+
+@message
+class ShardConfig:
+    start: int = 0
+    end: int = 0
+    indices: List[int] = field(default_factory=list)
+
+
+@message
+class TaskRequest:
+    dataset_name: str = ""
+
+
+@message
+class Task:
+    task_id: int = -1
+    task_type: str = "none"
+    shard: ShardConfig = field(default_factory=ShardConfig)
+    dataset_name: str = ""
+
+
+@message
+class TaskResult:
+    dataset_name: str = ""
+    task_id: int = -1
+    err_message: str = ""
+
+
+@message
+class DatasetTaskEnd:
+    dataset_name: str = ""
+
+
+@message
+class ShardCheckpointRequest:
+    dataset_name: str = ""
+
+
+@message
+class ShardCheckpoint:
+    content: str = ""  # JSON state of the dataset splitter / task queues
+
+
+# ---------------------------------------------------------------- rendezvous
+
+
+@message
+class JoinRendezvousRequest:
+    node_id: int = -1
+    node_rank: int = -1
+    local_world_size: int = 1  # local accelerator/process count
+    rdzv_name: str = ""
+    node_ip: str = ""
+    free_port: int = 0
+
+
+@message
+class CommWorldRequest:
+    node_id: int = -1
+    rdzv_name: str = ""
+
+
+@message
+class RendezvousState:
+    rdzv_round: int = 0
+    group: int = 0
+    # node_rank -> (node_id, local_world_size, node_ip, free_port)
+    world: Dict[str, List] = field(default_factory=dict)
+    coordinator_addr: str = ""
+    complete: bool = False
+
+
+@message
+class WaitingNodeNumRequest:
+    node_id: int = -1
+    rdzv_name: str = ""
+
+
+@message
+class WaitingNodeNumResponse:
+    waiting_num: int = 0
+
+
+@message
+class NetworkReadyRequest:
+    pass
+
+
+@message
+class NetworkCheckResult:
+    node_id: int = -1
+    normal: bool = True
+    elapsed_time: float = 0.0
+
+
+@message
+class StragglerExistRequest:
+    pass
+
+
+@message
+class NetworkStatusResponse:
+    nodes: List[int] = field(default_factory=list)
+    reason: str = ""
+
+
+# ---------------------------------------------------------------- node lifecycle
+
+
+@message
+class NodeMeta:
+    node_type: str = "worker"
+    node_id: int = -1
+    node_rank: int = -1
+    addr: str = ""
+    cpu: float = 0.0
+    memory_mb: float = 0.0
+    accelerator_type: str = ""
+    accelerator_num: int = 0
+
+
+@message
+class HeartBeat:
+    node_id: int = -1
+    timestamp: float = 0.0
+    # piggyback diagnosis payloads (step progress, resource usage)
+    global_step: int = 0
+    resource: Dict[str, float] = field(default_factory=dict)
+
+
+@message
+class HeartbeatResponse:
+    action: str = ""  # "", "restart", "stop"
+
+
+@message
+class NodeEventReport:
+    node_id: int = -1
+    node_type: str = "worker"
+    event_type: str = ""
+    reason: str = ""
+    message: str = ""
+    level: str = "info"
+
+
+@message
+class NodeFailure:
+    node_id: int = -1
+    restart_count: int = 0
+    error_data: str = ""
+    level: str = "process"
+
+
+# ---------------------------------------------------------------- metrics
+
+
+@message
+class GlobalStep:
+    step: int = 0
+    timestamp: float = 0.0
+    elapsed_time_per_step: float = 0.0
+
+
+@message
+class ResourceStats:
+    node_id: int = -1
+    cpu_percent: float = 0.0
+    memory_mb: float = 0.0
+    accelerator_stats: Dict[str, float] = field(default_factory=dict)
+
+
+@message
+class ModelInfo:
+    num_params: int = 0
+    num_layers: int = 0
+    hidden_size: int = 0
+    seq_len: int = 0
+    flops_per_step: float = 0.0
+
+
+@message
+class CustomMetric:
+    data: Dict[str, float] = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------- kv store
+
+
+@message
+class KVStoreSetRequest:
+    key: str = ""
+    value: bytes = b""
+
+
+@message
+class KVStoreGetRequest:
+    key: str = ""
+
+
+@message
+class KVStoreMultiGetRequest:
+    keys: List[str] = field(default_factory=list)
+
+
+@message
+class KVStoreAddRequest:
+    key: str = ""
+    amount: int = 1
+
+
+@message
+class KVStoreResponse:
+    found: bool = False
+    value: bytes = b""
+    values: List[bytes] = field(default_factory=list)
+    num: int = 0
+
+
+# ---------------------------------------------------------------- parallelism config
+
+
+@message
+class ParallelConfig:
+    """Tuned parallel/runtime config pushed master→agent→trainer.
+
+    Parity: reference grpc.py ParallelConfig (dataloader + ckpt tuning); redesigned
+    to carry mesh shape for the JAX strategy layer.
+    """
+
+    dataloader_batch_size: int = 0
+    dataloader_num_workers: int = 0
+    ckpt_interval_steps: int = 0
+    mesh_shape: Dict[str, int] = field(default_factory=dict)
+    restart_version: int = 0
+
+
+@message
+class ParallelConfigRequest:
+    node_id: int = -1
+
+
+# ---------------------------------------------------------------- diagnosis
+
+
+@message
+class DiagnosisReport:
+    node_id: int = -1
+    payload_type: str = ""  # "step", "stack", "chip_metrics"
+    content: str = ""
+    timestamp: float = 0.0
+
+
+@message
+class DiagnosisAction:
+    action: str = ""  # "", "restart_worker", "relaunch_node"
+    reason: str = ""
+    node_id: int = -1

@@ -1,0 +1,313 @@
+"""Local IPC primitives shared between the agent and training processes.
+
+Parity: reference `dlrover/python/common/multi_process.py` (SharedLock:225,
+SharedQueue:346, SharedDict:453, POSIX SharedMemory wrapper) — a unix-domain-socket
+server per named resource owned by the agent process, plus POSIX shared memory for
+zero-copy tensor staging.  Used by the flash-checkpoint path (§3.3 of SURVEY.md):
+training procs write `jax.Array` shard bytes into shm and enqueue events for the
+agent-side async saver.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import socket
+import socketserver
+import struct
+import threading
+import time
+from multiprocessing import resource_tracker, shared_memory
+from typing import Any, Dict, Optional
+
+from .log import get_logger
+
+logger = get_logger("multi_process")
+
+SOCKET_DIR = os.getenv("DWT_SOCKET_DIR", "/tmp/dwt/sockets")
+
+_LEN = struct.Struct(">I")
+
+
+def _socket_path(name: str) -> str:
+    os.makedirs(SOCKET_DIR, exist_ok=True)
+    return os.path.join(SOCKET_DIR, f"{name}.sock")
+
+
+def _send(sock: socket.socket, obj: Any):
+    data = json.dumps(obj).encode()
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv(sock: socket.socket) -> Any:
+    hdr = b""
+    while len(hdr) < 4:
+        chunk = sock.recv(4 - len(hdr))
+        if not chunk:
+            raise ConnectionError("closed")
+        hdr += chunk
+    (n,) = _LEN.unpack(hdr)
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("closed")
+        buf += chunk
+    return json.loads(buf.decode())
+
+
+class LocalSocketComm:
+    """A named resource reachable over a unix socket.
+
+    The creating process (``master=True``) runs a server thread answering
+    requests; other processes connect as clients.  Subclasses implement
+    ``_handle(request) -> response``.
+    """
+
+    def __init__(self, name: str, master: bool = False):
+        self._name = name
+        self._path = _socket_path(name)
+        self._master = master
+        self._server = None
+        self._client_lock = threading.Lock()
+        self._client_sock: Optional[socket.socket] = None
+        if master:
+            self._start_server()
+
+    # ------------------------------------------------------------------ server
+
+    def _start_server(self):
+        if os.path.exists(self._path):
+            os.unlink(self._path)
+        outer = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                while True:
+                    try:
+                        req = _recv(self.request)
+                    except (ConnectionError, OSError):
+                        return
+                    try:
+                        resp = outer._handle(req)
+                    except Exception as e:  # noqa: BLE001
+                        resp = {"err": f"{type(e).__name__}: {e}"}
+                    try:
+                        _send(self.request, resp)
+                    except OSError:
+                        return
+
+        class _Server(socketserver.ThreadingUnixStreamServer):
+            daemon_threads = True
+
+        self._server = _Server(self._path, _Handler)
+        t = threading.Thread(target=self._server.serve_forever, daemon=True,
+                             name=f"dwt-ipc-{self._name}")
+        t.start()
+
+    def close(self):
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+            if os.path.exists(self._path):
+                os.unlink(self._path)
+        with self._client_lock:
+            if self._client_sock is not None:
+                self._client_sock.close()
+                self._client_sock = None
+
+    def _handle(self, request: Dict) -> Dict:
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------ client
+
+    def _request(self, req: Dict, timeout: float = 60.0) -> Dict:
+        if self._master:
+            return self._handle(req)
+        deadline = time.time() + timeout
+        with self._client_lock:
+            while True:
+                try:
+                    if self._client_sock is None:
+                        self._client_sock = socket.socket(socket.AF_UNIX,
+                                                          socket.SOCK_STREAM)
+                        self._client_sock.connect(self._path)
+                    _send(self._client_sock, req)
+                    resp = _recv(self._client_sock)
+                    if "err" in resp:
+                        raise RuntimeError(resp["err"])
+                    return resp
+                except (ConnectionError, FileNotFoundError, OSError):
+                    if self._client_sock is not None:
+                        self._client_sock.close()
+                        self._client_sock = None
+                    if time.time() > deadline:
+                        raise TimeoutError(
+                            f"IPC resource {self._name} unreachable")
+                    time.sleep(0.1)
+
+
+class SharedLock(LocalSocketComm):
+    """Cross-process lock. Parity: reference SharedLock (multi_process.py:225)."""
+
+    def __init__(self, name: str, master: bool = False):
+        self._lock = threading.Lock() if master else None
+        super().__init__(f"lock-{name}", master)
+
+    def _handle(self, request):
+        op = request["op"]
+        if op == "acquire":
+            ok = self._lock.acquire(blocking=request.get("blocking", True),
+                                    timeout=request.get("timeout", -1))
+            return {"ok": ok}
+        if op == "release":
+            try:
+                self._lock.release()
+            except RuntimeError:
+                pass
+            return {"ok": True}
+        if op == "locked":
+            return {"ok": self._lock.locked()}
+        raise ValueError(op)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        return self._request({"op": "acquire", "blocking": blocking,
+                              "timeout": timeout})["ok"]
+
+    def release(self):
+        self._request({"op": "release"})
+
+    def locked(self) -> bool:
+        return self._request({"op": "locked"})["ok"]
+
+
+class SharedQueue(LocalSocketComm):
+    """Cross-process FIFO queue. Parity: reference SharedQueue (:346)."""
+
+    def __init__(self, name: str, master: bool = False, maxsize: int = 0):
+        self._queue = queue.Queue(maxsize) if master else None
+        super().__init__(f"queue-{name}", master)
+
+    def _handle(self, request):
+        op = request["op"]
+        if op == "put":
+            self._queue.put(request["item"])
+            return {"ok": True}
+        if op == "get":
+            try:
+                item = self._queue.get(
+                    block=request.get("block", True),
+                    timeout=request.get("timeout"))
+                return {"ok": True, "item": item}
+            except queue.Empty:
+                return {"ok": False, "item": None}
+        if op == "qsize":
+            return {"ok": True, "n": self._queue.qsize()}
+        if op == "empty":
+            return {"ok": True, "n": int(self._queue.empty())}
+        raise ValueError(op)
+
+    def put(self, item: Any):
+        self._request({"op": "put", "item": item})
+
+    def get(self, block: bool = True, timeout: Optional[float] = None) -> Any:
+        wait = timeout if timeout is not None else 3600.0
+        resp = self._request({"op": "get", "block": block, "timeout": timeout},
+                             timeout=wait + 60.0)
+        if not resp["ok"]:
+            raise queue.Empty
+        return resp["item"]
+
+    def qsize(self) -> int:
+        return self._request({"op": "qsize"})["n"]
+
+    def empty(self) -> bool:
+        return bool(self._request({"op": "empty"})["n"])
+
+
+class SharedDict(LocalSocketComm):
+    """Cross-process dict. Parity: reference SharedDict (:453)."""
+
+    def __init__(self, name: str, master: bool = False):
+        self._dict: Dict = {} if master else None
+        self._dict_lock = threading.Lock() if master else None
+        super().__init__(f"dict-{name}", master)
+
+    def _handle(self, request):
+        op = request["op"]
+        with self._dict_lock:
+            if op == "set":
+                self._dict.update(request["items"])
+                return {"ok": True}
+            if op == "get":
+                return {"ok": True, "dict": self._dict}
+            if op == "pop":
+                return {"ok": True,
+                        "item": self._dict.pop(request["key"], None)}
+        raise ValueError(op)
+
+    def set(self, items: Dict):
+        self._request({"op": "set", "items": items})
+
+    def get(self) -> Dict:
+        return self._request({"op": "get"})["dict"]
+
+    def pop(self, key: str) -> Any:
+        return self._request({"op": "pop", "key": key})["item"]
+
+
+class SharedMemoryBuffer:
+    """POSIX shared-memory segment wrapper.
+
+    Parity: reference's SharedMemory (unregistered from the resource tracker so a
+    training-process exit doesn't tear down the agent's segment).
+    """
+
+    def __init__(self, name: str, create: bool = False, size: int = 0):
+        self.name = name
+        if create:
+            try:
+                existing = shared_memory.SharedMemory(name=name)
+            except FileNotFoundError:
+                existing = None
+            if existing is not None:
+                if existing.size >= size:
+                    self._shm = existing
+                    self._created = False
+                    self._unregister()
+                    return
+                existing.close()
+                existing.unlink()
+            self._shm = shared_memory.SharedMemory(name=name, create=True,
+                                                   size=size)
+            self._created = True
+        else:
+            self._shm = shared_memory.SharedMemory(name=name)
+            self._created = False
+        self._unregister()
+
+    def _unregister(self):
+        # Keep the segment alive independent of any single process's exit.
+        try:
+            resource_tracker.unregister(self._shm._name, "shared_memory")
+        except Exception:  # noqa: BLE001 — best-effort; impl detail of CPython
+            pass
+
+    @property
+    def buf(self) -> memoryview:
+        return self._shm.buf
+
+    @property
+    def size(self) -> int:
+        return self._shm.size
+
+    def close(self):
+        self._shm.close()
+
+    def unlink(self):
+        try:
+            self._shm.unlink()
+        except FileNotFoundError:
+            pass

@@ -1,0 +1,15 @@
+"""Test config: run JAX on a virtual 8-device CPU mesh (no TPU needed).
+
+Mirrors the reference's test strategy (SURVEY.md §4): multi-node logic is tested
+on a single host — here with XLA's forced host-platform device count.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("DWT_SOCKET_DIR", "/tmp/dwt-test/sockets")

@@ -1,0 +1,187 @@
+"""Tests for the core runtime layer (serialize, comm, node, storage, IPC).
+
+Mirrors reference tests `dlrover/python/tests/test_multi_process.py`,
+`test_servicer.py` style: in-process servers, no cluster.
+"""
+
+import multiprocessing as mp
+import os
+import threading
+import time
+
+import pytest
+
+from dlrover_wuqiong_tpu.common import comm, serialize
+from dlrover_wuqiong_tpu.common.constants import NodeStatus
+from dlrover_wuqiong_tpu.common.messages import (
+    HeartBeat,
+    JoinRendezvousRequest,
+    OkResponse,
+    RendezvousState,
+    Task,
+    ShardConfig,
+)
+from dlrover_wuqiong_tpu.common.multi_process import (
+    SharedDict,
+    SharedLock,
+    SharedMemoryBuffer,
+    SharedQueue,
+)
+from dlrover_wuqiong_tpu.common.node import Node, NodeStateFlow
+from dlrover_wuqiong_tpu.common.storage import PosixDiskStorage, get_checkpoint_storage
+
+
+class TestSerialize:
+    def test_roundtrip_nested(self):
+        t = Task(task_id=3, task_type="training",
+                 shard=ShardConfig(start=10, end=20), dataset_name="ds")
+        data = serialize.dumps(t)
+        back = serialize.loads(data)
+        assert isinstance(back, Task)
+        assert back.task_id == 3
+        assert back.shard.start == 10 and back.shard.end == 20
+
+    def test_bytes_roundtrip(self):
+        from dlrover_wuqiong_tpu.common.messages import KVStoreSetRequest
+        req = KVStoreSetRequest(key="a", value=b"\x00\xff\x01")
+        back = serialize.loads(serialize.dumps(req))
+        assert back.value == b"\x00\xff\x01"
+
+    def test_plain_dict(self):
+        obj = {"verb": "get", "payload": HeartBeat(node_id=1, timestamp=2.0)}
+        back = serialize.loads(serialize.dumps(obj))
+        assert back["verb"] == "get"
+        assert isinstance(back["payload"], HeartBeat)
+
+
+class TestRpc:
+    def test_get_report_roundtrip(self):
+        def handler(verb, node_id, node_type, payload):
+            if verb == "get" and isinstance(payload, JoinRendezvousRequest):
+                return RendezvousState(rdzv_round=1, complete=True)
+            return OkResponse()
+
+        server = comm.RpcServer(handler, host="127.0.0.1")
+        server.start()
+        try:
+            client = comm.RpcClient(f"127.0.0.1:{server.port}", node_id=0)
+            resp = client.get(JoinRendezvousRequest(node_id=0, node_rank=0))
+            assert isinstance(resp, RendezvousState)
+            assert resp.complete
+            resp2 = client.report(HeartBeat(node_id=0))
+            assert isinstance(resp2, OkResponse)
+            client.close()
+        finally:
+            server.stop()
+
+    def test_handler_error_propagates(self):
+        def handler(verb, node_id, node_type, payload):
+            raise ValueError("boom")
+
+        server = comm.RpcServer(handler, host="127.0.0.1")
+        server.start()
+        try:
+            client = comm.RpcClient(f"127.0.0.1:{server.port}")
+            with pytest.raises(comm.RpcError, match="boom"):
+                client.get(HeartBeat())
+        finally:
+            server.stop()
+
+    def test_addr_connectable(self):
+        server = comm.RpcServer(lambda *a: OkResponse(), host="127.0.0.1")
+        server.start()
+        assert comm.addr_connectable(f"127.0.0.1:{server.port}")
+        server.stop()
+        assert not comm.addr_connectable("127.0.0.1:1")
+
+
+class TestNode:
+    def test_status_flow(self):
+        assert NodeStateFlow.can_transition(NodeStatus.PENDING,
+                                            NodeStatus.RUNNING)
+        assert not NodeStateFlow.can_transition(NodeStatus.SUCCEEDED,
+                                                NodeStatus.RUNNING)
+        assert NodeStateFlow.should_relaunch(NodeStatus.RUNNING,
+                                             NodeStatus.FAILED)
+        assert not NodeStateFlow.should_relaunch(NodeStatus.RUNNING,
+                                                 NodeStatus.SUCCEEDED)
+
+    def test_relaunch_info(self):
+        n = Node("worker", 0, max_relaunch_count=2)
+        n.update_status(NodeStatus.RUNNING)
+        assert n.start_time is not None
+        n2 = n.get_relaunch_node_info(new_id=7)
+        assert n2.id == 7 and n2.rank_index == 0 and n2.relaunch_count == 1
+        n.relaunch_count = 2
+        assert n.is_unrecoverable_failure()
+
+
+class TestStorage:
+    def test_posix_roundtrip(self, tmp_path):
+        s = PosixDiskStorage()
+        p = str(tmp_path / "a" / "b.bin")
+        s.write(b"hello", p)
+        assert s.read(p) == b"hello"
+        assert s.exists(p)
+        s.safe_remove(p)
+        assert not s.exists(p)
+
+    def test_registry(self):
+        s = get_checkpoint_storage({"class_name": "PosixDiskStorage",
+                                    "kwargs": {}})
+        assert isinstance(s, PosixDiskStorage)
+
+
+def _queue_worker(in_name, out_name):
+    q_in = SharedQueue(in_name, master=False)
+    q_out = SharedQueue(out_name, master=False)
+    item = q_in.get(timeout=10)
+    q_out.put({"echo": item})
+
+
+class TestIpc:
+    def test_shared_lock_same_process(self):
+        lock = SharedLock("t1", master=True)
+        assert lock.acquire()
+        assert lock.locked()
+        lock.release()
+        assert not lock.locked()
+        lock.close()
+
+    def test_shared_queue_cross_process(self):
+        q_in = SharedQueue("t2-in", master=True)
+        q_out = SharedQueue("t2-out", master=True)
+        proc = mp.get_context("spawn").Process(
+            target=_queue_worker, args=("t2-in", "t2-out"))
+        proc.start()
+        q_in.put(42)
+        got = q_out.get(timeout=15)
+        proc.join(timeout=10)
+        assert got == {"echo": 42}
+        q_in.close()
+        q_out.close()
+
+    def test_shared_dict(self):
+        d = SharedDict("t3", master=True)
+        d.set({"a": 1, "b": [1, 2]})
+        assert d.get() == {"a": 1, "b": [1, 2]}
+        assert d.pop("a") == 1
+        assert d.get() == {"b": [1, 2]}
+        d.close()
+
+    def test_shared_memory_buffer(self):
+        buf = SharedMemoryBuffer("dwt-test-shm", create=True, size=1024)
+        buf.buf[:5] = b"hello"
+        other = SharedMemoryBuffer("dwt-test-shm")
+        assert bytes(other.buf[:5]) == b"hello"
+        other.close()
+        buf.close()
+        buf.unlink()
+
+    def test_shared_memory_grow(self):
+        buf = SharedMemoryBuffer("dwt-test-shm2", create=True, size=64)
+        buf.close()
+        big = SharedMemoryBuffer("dwt-test-shm2", create=True, size=4096)
+        assert big.size >= 4096
+        big.close()
+        big.unlink()
