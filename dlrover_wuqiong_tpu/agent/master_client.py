@@ -1,0 +1,182 @@
+"""Typed client wrapper over the master's get/report RPCs.
+
+Parity: reference `dlrover/python/elastic_agent/master_client.py` (MasterClient
+:50, get_task :133, join_rendezvous, report_heart_beat :230) and the torch-Store
+client `master_kv_store.py` — here the KV store seeds jax.distributed bootstrap.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..common import messages as msg
+from ..common.comm import RpcClient
+from ..common.constants import RendezvousName
+from ..common.log import get_logger
+
+logger = get_logger("master_client")
+
+
+class MasterClient:
+    _instance = None
+    _lock = threading.Lock()
+
+    def __init__(self, master_addr: str, node_id: int,
+                 node_type: str = "worker"):
+        self._client = RpcClient(master_addr, node_id, node_type)
+        self.master_addr = master_addr
+        self.node_id = node_id
+        self.node_type = node_type
+
+    @classmethod
+    def singleton(cls, master_addr: Optional[str] = None,
+                  node_id: int = -1, node_type: str = "worker"):
+        with cls._lock:
+            if cls._instance is None:
+                if master_addr is None:
+                    raise ValueError("master_addr required on first call")
+                cls._instance = cls(master_addr, node_id, node_type)
+            return cls._instance
+
+    @classmethod
+    def reset(cls):
+        with cls._lock:
+            if cls._instance is not None:
+                cls._instance.close()
+            cls._instance = None
+
+    def close(self):
+        self._client.close()
+
+    # ------------------------------------------------------------- dataset
+
+    def report_dataset_shard_params(self, **kwargs):
+        return self._client.report(msg.DatasetShardParams(**kwargs))
+
+    def get_task(self, dataset_name: str) -> msg.Task:
+        return self._client.get(msg.TaskRequest(dataset_name=dataset_name))
+
+    def report_task_result(self, dataset_name: str, task_id: int,
+                           err_message: str = ""):
+        return self._client.report(msg.TaskResult(
+            dataset_name=dataset_name, task_id=task_id,
+            err_message=err_message))
+
+    def get_shard_checkpoint(self, dataset_name: str) -> str:
+        resp = self._client.get(
+            msg.ShardCheckpointRequest(dataset_name=dataset_name))
+        return resp.content
+
+    def report_shard_checkpoint(self, content: str):
+        return self._client.report(msg.ShardCheckpoint(content=content))
+
+    # ------------------------------------------------------------- rendezvous
+
+    def join_rendezvous(self, node_rank: int, local_world_size: int,
+                        rdzv_name: str = RendezvousName.ELASTIC_TRAINING,
+                        node_ip: str = "127.0.0.1",
+                        free_port: int = 0) -> int:
+        resp = self._client.report(msg.JoinRendezvousRequest(
+            node_id=self.node_id, node_rank=node_rank,
+            local_world_size=local_world_size, rdzv_name=rdzv_name,
+            node_ip=node_ip, free_port=free_port))
+        return resp.rdzv_round
+
+    def get_comm_world(
+        self, rdzv_name: str = RendezvousName.ELASTIC_TRAINING
+    ) -> msg.RendezvousState:
+        return self._client.get(msg.CommWorldRequest(
+            node_id=self.node_id, rdzv_name=rdzv_name))
+
+    def num_nodes_waiting(
+        self, rdzv_name: str = RendezvousName.ELASTIC_TRAINING
+    ) -> int:
+        resp = self._client.get(msg.WaitingNodeNumRequest(
+            node_id=self.node_id, rdzv_name=rdzv_name))
+        return resp.waiting_num
+
+    def network_check_success(self) -> Tuple[bool, str]:
+        resp = self._client.get(msg.NetworkReadyRequest())
+        return resp.success, resp.reason
+
+    def report_network_check_result(self, normal: bool, elapsed: float):
+        return self._client.report(msg.NetworkCheckResult(
+            node_id=self.node_id, normal=normal, elapsed_time=elapsed))
+
+    def get_stragglers(self) -> List[int]:
+        resp = self._client.get(msg.StragglerExistRequest())
+        return resp.nodes
+
+    # ------------------------------------------------------------- lifecycle
+
+    def register_node(self, node_rank: int, addr: str = "",
+                      accelerator_type: str = "tpu",
+                      accelerator_num: int = 0):
+        return self._client.report(msg.NodeMeta(
+            node_type=self.node_type, node_id=self.node_id,
+            node_rank=node_rank, addr=addr,
+            accelerator_type=accelerator_type,
+            accelerator_num=accelerator_num))
+
+    def report_heart_beat(self, global_step: int = 0) -> str:
+        resp = self._client.report(msg.HeartBeat(
+            node_id=self.node_id, timestamp=time.time(),
+            global_step=global_step))
+        return resp.action
+
+    def report_failure(self, error_data: str, restart_count: int = 0,
+                       level: str = "process"):
+        return self._client.report(msg.NodeFailure(
+            node_id=self.node_id, restart_count=restart_count,
+            error_data=error_data, level=level))
+
+    def report_global_step(self, step: int,
+                           elapsed_time_per_step: float = 0.0):
+        return self._client.report(msg.GlobalStep(
+            step=step, timestamp=time.time(),
+            elapsed_time_per_step=elapsed_time_per_step))
+
+    def report_node_event(self, event_type: str, message: str = "",
+                          level: str = "info"):
+        return self._client.report(msg.NodeEventReport(
+            node_id=self.node_id, node_type=self.node_type,
+            event_type=event_type, message=message, level=level))
+
+    def report_diagnosis(self, payload_type: str,
+                         content: str) -> msg.DiagnosisAction:
+        return self._client.report(msg.DiagnosisReport(
+            node_id=self.node_id, payload_type=payload_type,
+            content=content, timestamp=time.time()))
+
+    def get_paral_config(self) -> msg.ParallelConfig:
+        return self._client.get(
+            msg.ParallelConfigRequest(node_id=self.node_id))
+
+    # ------------------------------------------------------------- kv store
+
+    def kv_store_set(self, key: str, value: bytes):
+        return self._client.report(msg.KVStoreSetRequest(key=key,
+                                                         value=value))
+
+    def kv_store_get(self, key: str) -> Optional[bytes]:
+        resp = self._client.get(msg.KVStoreGetRequest(key=key))
+        return resp.value if resp.found else None
+
+    def kv_store_multi_get(self, keys: List[str]) -> Optional[List[bytes]]:
+        resp = self._client.get(msg.KVStoreMultiGetRequest(keys=keys))
+        return resp.values if resp.found else None
+
+    def kv_store_add(self, key: str, amount: int = 1) -> int:
+        resp = self._client.get(msg.KVStoreAddRequest(key=key, amount=amount))
+        return resp.num
+
+    def kv_store_wait(self, keys: List[str], timeout: float = 300.0,
+                      poll: float = 0.2) -> bool:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.kv_store_multi_get(keys) is not None:
+                return True
+            time.sleep(poll)
+        return False

@@ -1,0 +1,158 @@
+"""Diagnosis subsystem: collect runtime reports, infer failures (hang, slow).
+
+Parity: reference `dlrover/python/master/diagnosis/` (`DiagnosisManager` :31,
+`_diagnose_failures` :67, `InferenceChain`, `CheckTrainingHangOperator`) and
+data model `common/diagnosis.py`.  TPU adaptation: reports carry step progress,
+host resource stats, and (later) libtpu chip metrics instead of CudaLog.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict, deque
+from typing import Deque, Dict, List, Optional
+
+from ..common import messages as msg
+from ..common.log import get_logger
+
+logger = get_logger("diagnosis")
+
+
+class InferenceOperator:
+    """One rule in the inference chain: observations -> conclusions."""
+
+    name = "base"
+
+    def infer(self, data: "DiagnosisDataManager") -> List[msg.DiagnosisAction]:
+        return []
+
+
+class CheckTrainingHangOperator(InferenceOperator):
+    """Training is hanged if no node reported step progress for `timeout` s.
+
+    Parity: reference diagnosis/operator/check_training_hang_operator.py.
+    """
+
+    name = "check_training_hang"
+
+    def __init__(self, timeout: float = 1800.0):
+        self.timeout = timeout
+
+    def infer(self, data: "DiagnosisDataManager") -> List[msg.DiagnosisAction]:
+        latest = data.latest_step_time()
+        if latest is None:
+            return []
+        if time.time() - latest > self.timeout:
+            return [msg.DiagnosisAction(
+                action="restart_worker",
+                reason=f"no step progress for >{self.timeout}s")]
+        return []
+
+
+class CheckResourceAnomalyOperator(InferenceOperator):
+    """Flag nodes with pathological host-memory growth (OOM precursor)."""
+
+    name = "check_resource_anomaly"
+
+    def __init__(self, memory_limit_mb: float = 0.0):
+        self.memory_limit_mb = memory_limit_mb
+
+    def infer(self, data: "DiagnosisDataManager") -> List[msg.DiagnosisAction]:
+        if self.memory_limit_mb <= 0:
+            return []
+        actions = []
+        for node_id, stats in data.latest_resource_stats().items():
+            if stats.get("memory_mb", 0.0) > self.memory_limit_mb:
+                actions.append(msg.DiagnosisAction(
+                    action="relaunch_node", node_id=node_id,
+                    reason="host memory over limit"))
+        return actions
+
+
+class DiagnosisDataManager:
+    """Sliding-window store of diagnosis reports."""
+
+    def __init__(self, window: int = 600):
+        self._lock = threading.Lock()
+        self._step_reports: Deque = deque(maxlen=window)
+        self._resource: Dict[int, Dict[str, float]] = {}
+        self._stacks: Dict[int, str] = {}
+
+    def store_report(self, report: msg.DiagnosisReport):
+        with self._lock:
+            ts = report.timestamp or time.time()
+            if report.payload_type == "step":
+                self._step_reports.append((ts, report.node_id,
+                                           report.content))
+            elif report.payload_type == "resource":
+                try:
+                    import json
+                    self._resource[report.node_id] = json.loads(
+                        report.content)
+                except ValueError:
+                    pass
+            elif report.payload_type == "stack":
+                self._stacks[report.node_id] = report.content
+
+    def latest_step_time(self) -> Optional[float]:
+        with self._lock:
+            if not self._step_reports:
+                return None
+            return self._step_reports[-1][0]
+
+    def latest_resource_stats(self) -> Dict[int, Dict[str, float]]:
+        with self._lock:
+            return dict(self._resource)
+
+    def node_stack(self, node_id: int) -> str:
+        with self._lock:
+            return self._stacks.get(node_id, "")
+
+
+class DiagnosisManager:
+    """Periodic inference over collected metrics (parity diagnosis.py:31)."""
+
+    def __init__(self, hang_timeout: float = 1800.0):
+        self.data = DiagnosisDataManager()
+        self._operators: List[InferenceOperator] = [
+            CheckTrainingHangOperator(hang_timeout),
+            CheckResourceAnomalyOperator(),
+        ]
+        self._pending_actions: Deque[msg.DiagnosisAction] = deque()
+        self._lock = threading.Lock()
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def collect_report(self, report: msg.DiagnosisReport) -> msg.DiagnosisAction:
+        self.data.store_report(report)
+        with self._lock:
+            if self._pending_actions:
+                return self._pending_actions.popleft()
+        return msg.DiagnosisAction()
+
+    def diagnose_once(self) -> List[msg.DiagnosisAction]:
+        actions: List[msg.DiagnosisAction] = []
+        for op in self._operators:
+            try:
+                actions.extend(op.infer(self.data))
+            except Exception:  # noqa: BLE001
+                logger.exception("diagnosis operator %s failed", op.name)
+        with self._lock:
+            self._pending_actions.extend(actions)
+        return actions
+
+    def start(self, interval: float = 60.0):
+        def _loop():
+            while not self._stopped.wait(interval):
+                acts = self.diagnose_once()
+                for a in acts:
+                    logger.warning("diagnosis action: %s (%s)", a.action,
+                                   a.reason)
+
+        self._thread = threading.Thread(target=_loop, daemon=True,
+                                        name="dwt-diagnosis")
+        self._thread.start()
+
+    def stop(self):
+        self._stopped.set()

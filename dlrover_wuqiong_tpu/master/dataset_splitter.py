@@ -1,0 +1,221 @@
+"""Dataset splitters: partition datasets into checkpointable shards.
+
+Parity: reference `dlrover/python/master/shard/dataset_splitter.py`
+(`DatasetSplitter` ABC :90, `TableDatasetSplitter` :144, `TextDatasetSplitter`
+:257, `StreamingDatasetSplitter` :359 with to/from_checkpoint :414-421).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..common.log import get_logger
+
+logger = get_logger("dataset_splitter")
+
+
+@dataclass
+class Shard:
+    name: str
+    start: int
+    end: int
+    record_indices: List[int] = field(default_factory=list)
+
+
+class DatasetSplitter(ABC):
+    def __init__(self, dataset_name: str, dataset_size: int, shard_size: int,
+                 num_epochs: int = 1):
+        self.dataset_name = dataset_name
+        self.dataset_size = dataset_size
+        self.shard_size = max(1, shard_size)
+        self.num_epochs = max(1, num_epochs)
+        self.epoch = 0
+
+    @abstractmethod
+    def create_shards(self) -> None: ...
+
+    @abstractmethod
+    def get_shards(self) -> List[Shard]: ...
+
+    def epoch_finished(self) -> bool:
+        return self.epoch >= self.num_epochs
+
+    @abstractmethod
+    def to_checkpoint(self) -> Dict: ...
+
+    @staticmethod
+    def from_checkpoint(data: Dict) -> "DatasetSplitter":
+        kind = data.get("kind")
+        cls = {
+            "table": TableDatasetSplitter,
+            "text": TextDatasetSplitter,
+            "streaming": StreamingDatasetSplitter,
+        }.get(kind)
+        if cls is None:
+            raise ValueError(f"unknown splitter kind {kind}")
+        return cls._restore(data)
+
+
+class TableDatasetSplitter(DatasetSplitter):
+    """Contiguous [start, end) ranges over a table (parity :144)."""
+
+    KIND = "table"
+
+    def __init__(self, dataset_name: str, dataset_size: int, shard_size: int,
+                 num_epochs: int = 1, shuffle: bool = False,
+                 max_shard_count: int = 50000):
+        super().__init__(dataset_name, dataset_size, shard_size, num_epochs)
+        self.shuffle = shuffle
+        self.max_shard_count = max_shard_count
+        self._shards: List[Shard] = []
+
+    def create_shards(self):
+        starts = list(range(0, self.dataset_size, self.shard_size))
+        if self.shuffle:
+            random.shuffle(starts)
+        self._shards = [
+            Shard(self.dataset_name, s, min(s + self.shard_size,
+                                            self.dataset_size))
+            for s in starts[: self.max_shard_count]
+        ]
+        self.epoch += 1
+
+    def get_shards(self) -> List[Shard]:
+        return self._shards
+
+    def to_checkpoint(self) -> Dict:
+        return {
+            "kind": self.KIND,
+            "dataset_name": self.dataset_name,
+            "dataset_size": self.dataset_size,
+            "shard_size": self.shard_size,
+            "num_epochs": self.num_epochs,
+            "epoch": self.epoch,
+            "shuffle": self.shuffle,
+        }
+
+    @classmethod
+    def _restore(cls, data: Dict) -> "TableDatasetSplitter":
+        obj = cls(data["dataset_name"], data["dataset_size"],
+                  data["shard_size"], data["num_epochs"],
+                  data.get("shuffle", False))
+        obj.epoch = data.get("epoch", 0)
+        return obj
+
+
+class TextDatasetSplitter(DatasetSplitter):
+    """Shards carry explicit record indices (shuffled line files, parity :257)."""
+
+    KIND = "text"
+
+    def __init__(self, dataset_name: str, dataset_size: int, shard_size: int,
+                 num_epochs: int = 1, shuffle: bool = False):
+        super().__init__(dataset_name, dataset_size, shard_size, num_epochs)
+        self.shuffle = shuffle
+        self._shards: List[Shard] = []
+
+    def create_shards(self):
+        indices = list(range(self.dataset_size))
+        if self.shuffle:
+            random.shuffle(indices)
+        self._shards = []
+        for i in range(0, self.dataset_size, self.shard_size):
+            chunk = indices[i:i + self.shard_size]
+            self._shards.append(
+                Shard(self.dataset_name, i, i + len(chunk),
+                      record_indices=chunk))
+        self.epoch += 1
+
+    def get_shards(self) -> List[Shard]:
+        return self._shards
+
+    def to_checkpoint(self) -> Dict:
+        return {
+            "kind": self.KIND,
+            "dataset_name": self.dataset_name,
+            "dataset_size": self.dataset_size,
+            "shard_size": self.shard_size,
+            "num_epochs": self.num_epochs,
+            "epoch": self.epoch,
+            "shuffle": self.shuffle,
+        }
+
+    @classmethod
+    def _restore(cls, data: Dict) -> "TextDatasetSplitter":
+        obj = cls(data["dataset_name"], data["dataset_size"],
+                  data["shard_size"], data["num_epochs"],
+                  data.get("shuffle", False))
+        obj.epoch = data.get("epoch", 0)
+        return obj
+
+
+class StreamingDatasetSplitter(DatasetSplitter):
+    """Unbounded stream split by offset; checkpoint keeps the frontier
+    (parity :359, to/from_checkpoint :414-421)."""
+
+    KIND = "streaming"
+
+    def __init__(self, dataset_name: str, shard_size: int,
+                 partition_offset: int = 0, fetch_data_size: int = 10000):
+        super().__init__(dataset_name, -1, shard_size, num_epochs=1)
+        self.partition_offset = partition_offset
+        self.fetch_data_size = fetch_data_size
+        self._shards: List[Shard] = []
+
+    def create_shards(self):
+        self._shards = []
+        end = self.partition_offset + self.fetch_data_size
+        for s in range(self.partition_offset, end, self.shard_size):
+            self._shards.append(
+                Shard(self.dataset_name, s, min(s + self.shard_size, end)))
+        self.partition_offset = end
+
+    def epoch_finished(self) -> bool:
+        return False  # streams never finish by epoch
+
+    def get_shards(self) -> List[Shard]:
+        return self._shards
+
+    def to_checkpoint(self) -> Dict:
+        return {
+            "kind": self.KIND,
+            "dataset_name": self.dataset_name,
+            "shard_size": self.shard_size,
+            "partition_offset": self.partition_offset,
+            "fetch_data_size": self.fetch_data_size,
+            "unfinished_shards": [
+                [s.start, s.end] for s in self._shards
+            ],
+        }
+
+    @classmethod
+    def _restore(cls, data: Dict) -> "StreamingDatasetSplitter":
+        obj = cls(data["dataset_name"], data["shard_size"],
+                  data.get("partition_offset", 0),
+                  data.get("fetch_data_size", 10000))
+        obj._shards = [
+            Shard(obj.dataset_name, s, e)
+            for s, e in data.get("unfinished_shards", [])
+        ]
+        return obj
+
+
+def new_dataset_splitter(storage_type: str, shuffle: bool, dataset_size: int,
+                         batch_size: int, num_epochs: int,
+                         num_minibatches_per_shard: int,
+                         dataset_name: str) -> DatasetSplitter:
+    """Factory mirroring reference `new_dataset_splitter`."""
+    shard_size = max(1, batch_size * max(1, num_minibatches_per_shard))
+    if storage_type in ("", "table"):
+        return TableDatasetSplitter(dataset_name, dataset_size, shard_size,
+                                    num_epochs, shuffle)
+    if storage_type == "text":
+        return TextDatasetSplitter(dataset_name, dataset_size, shard_size,
+                                   num_epochs, shuffle)
+    if storage_type == "streaming":
+        return StreamingDatasetSplitter(dataset_name, shard_size)
+    raise ValueError(f"unknown storage type: {storage_type}")

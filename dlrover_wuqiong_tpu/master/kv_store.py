@@ -1,0 +1,59 @@
+"""In-master KV store backing worker-side coordination bootstrap.
+
+Parity: reference `master/elastic_training/kv_store_service.py` + the torch
+`Store` client in `elastic_agent/torch/master_kv_store.py`.  In the TPU stack the
+KV store seeds `jax.distributed` bootstrap data and barriers between agents.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class KVStoreService:
+    def __init__(self):
+        self._store: Dict[str, bytes] = {}
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+
+    def set(self, key: str, value: bytes):
+        with self._cond:
+            self._store[key] = value
+            self._cond.notify_all()
+
+    def get(self, key: str) -> Optional[bytes]:
+        with self._lock:
+            return self._store.get(key)
+
+    def add(self, key: str, amount: int = 1) -> int:
+        """Atomic counter add; value stored as ascii int (torch Store semantics)."""
+        with self._cond:
+            cur = int(self._store.get(key, b"0"))
+            cur += amount
+            self._store[key] = str(cur).encode()
+            self._cond.notify_all()
+            return cur
+
+    def wait(self, keys: List[str], timeout: float = 300.0) -> bool:
+        deadline = time.time() + timeout
+        with self._cond:
+            while not all(k in self._store for k in keys):
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+            return True
+
+    def multi_get(self, keys: List[str]) -> List[Optional[bytes]]:
+        with self._lock:
+            return [self._store.get(k) for k in keys]
+
+    def delete(self, key: str) -> bool:
+        with self._lock:
+            return self._store.pop(key, None) is not None
+
+    def clear(self):
+        with self._lock:
+            self._store.clear()

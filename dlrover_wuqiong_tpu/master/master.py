@@ -1,0 +1,164 @@
+"""Job master: composes managers + RPC service; main loop.
+
+Parity: reference `dlrover/python/master/main.py` (run :43),
+`master/master.py` (JobMaster ABC), `master/dist_master.py:86`
+(DistributedJobMaster composing JobManager/TaskManager/RendezvousManagers/
+SpeedMonitor/DiagnosisManager + servicer), `master/local_master.py:38`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from ..common import messages as msg
+from ..common.constants import JobExitReason, RendezvousName
+from ..common.global_context import get_context
+from ..common.log import get_logger
+from ..diagnosis.manager import DiagnosisManager
+from .job_manager import JobManager, LocalJobManager, Scaler
+from .kv_store import KVStoreService
+from .rendezvous import (
+    ElasticTrainingRendezvousManager,
+    NetworkCheckRendezvousManager,
+)
+from .servicer import create_master_service
+from .speed_monitor import SpeedMonitor
+from .task_manager import TaskManager
+
+logger = get_logger("master")
+
+
+class JobMaster:
+    """One master per job; owns control-plane state and the RPC service."""
+
+    def __init__(self, port: int = 0, min_nodes: int = 1,
+                 max_nodes: int = 1, node_unit: int = 1,
+                 scaler: Optional[Scaler] = None,
+                 job_manager: Optional[JobManager] = None):
+        ctx = get_context()
+        self.speed_monitor = SpeedMonitor(ctx.train_speed_record_num)
+        self.job_manager = job_manager or LocalJobManager(scaler=scaler)
+        self.task_manager = TaskManager()
+        self.task_manager.speed_monitor = self.speed_monitor
+        self.rdzv_managers = {
+            RendezvousName.ELASTIC_TRAINING:
+                ElasticTrainingRendezvousManager(),
+            RendezvousName.NETWORK_CHECK: NetworkCheckRendezvousManager(),
+        }
+        for rdzv in self.rdzv_managers.values():
+            rdzv.update_rdzv_params(
+                min_nodes, max_nodes,
+                waiting_timeout=5.0 if max_nodes > min_nodes else 0.5,
+                join_timeout=ctx.rdzv_join_timeout,
+                node_unit=node_unit)
+        self.kv_store = KVStoreService()
+        self.diagnosis_manager = DiagnosisManager(ctx.hang_detection_seconds)
+        self._custom_metrics: Dict = {}
+        self._node_events: list = []
+        self._paral_config = msg.ParallelConfig()
+        self._server = create_master_service(self, port=port)
+        self._exit_code = 0
+        self._exit_reason = ""
+        self._stopped = threading.Event()
+
+    # --------------------------------------------------------------- service
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    @property
+    def addr(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def prepare(self):
+        self._server.start()
+        self.diagnosis_manager.start(interval=60.0)
+        logger.info("master ready on port %s", self.port)
+
+    def stop(self):
+        self._stopped.set()
+        self.diagnosis_manager.stop()
+        self._server.stop()
+
+    # --------------------------------------------------------------- hooks
+
+    def get_paral_config(self, node_id: int) -> msg.ParallelConfig:
+        return self._paral_config
+
+    def update_paral_config(self, config: msg.ParallelConfig):
+        config.restart_version = self._paral_config.restart_version + 1
+        self._paral_config = config
+
+    def collect_custom_data(self, payload):
+        self._custom_metrics[type(payload).__name__] = payload
+
+    def record_node_event(self, event: msg.NodeEventReport):
+        self._node_events.append(event)
+        if len(self._node_events) > 1000:
+            self._node_events = self._node_events[-500:]
+
+    # --------------------------------------------------------------- run loop
+
+    def run(self, poll_interval: float = 5.0,
+            max_seconds: Optional[float] = None) -> int:
+        """Main loop: watch for completion / failure / hang.
+
+        Parity: reference dist_master.py:211 30s loop (early-stop checks,
+        all_workers_exited, task_hanged → exit code).
+        """
+        ctx = get_context()
+        start = time.time()
+        while not self._stopped.wait(poll_interval):
+            if max_seconds and time.time() - start > max_seconds:
+                self._exit_reason = JobExitReason.UNCOMPLETED_TIMEOUT
+                self._exit_code = 1
+                break
+            # dead-node sweep (heartbeat timeouts)
+            for node in self.job_manager.get_dead_nodes():
+                logger.warning("node %s heartbeat timeout — marking failed",
+                               node.id)
+                from ..common.constants import NodeEventType, NodeStatus
+                from ..common.node import Node, NodeEvent
+                dead = Node(node.type, node.id, rank_index=node.rank_index)
+                dead.status = NodeStatus.FAILED
+                dead.exit_reason = "Hang"
+                self.job_manager.process_event(
+                    NodeEvent(NodeEventType.MODIFIED, dead))
+                self.task_manager.recover_tasks(node.id)
+                for rdzv in self.rdzv_managers.values():
+                    rdzv.remove_alive_node(node.id)
+                self.speed_monitor.remove_running_worker(node.id)
+            if self.job_manager.all_workers_exited():
+                if self.job_manager.all_workers_succeeded():
+                    self._exit_reason = JobExitReason.SUCCEEDED
+                    self._exit_code = 0
+                else:
+                    self._exit_reason = JobExitReason.WORKER_ERROR
+                    self._exit_code = 1
+                break
+            if self.task_manager.task_hanged(ctx.hang_detection_seconds):
+                self._exit_reason = JobExitReason.HANG_ERROR
+                self._exit_code = 1
+                break
+        logger.info("master exiting: reason=%s code=%d", self._exit_reason,
+                    self._exit_code)
+        return self._exit_code
+
+    @property
+    def exit_reason(self) -> str:
+        return self._exit_reason
+
+
+def run_master_forever(port: int, min_nodes: int, max_nodes: int,
+                       node_unit: int = 1):
+    """Entry for a standalone master process (parity master/main.py:63)."""
+    master = JobMaster(port=port, min_nodes=min_nodes, max_nodes=max_nodes,
+                       node_unit=node_unit)
+    master.prepare()
+    try:
+        return master.run()
+    finally:
+        master.stop()

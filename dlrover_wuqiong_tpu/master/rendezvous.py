@@ -1,0 +1,300 @@
+"""Master-side rendezvous managers.
+
+Parity: reference `dlrover/python/master/elastic_training/rdzv_manager.py`
+(`RendezvousManager` ABC :58, `ElasticTrainingRendezvousManager` :291,
+`NetworkCheckRendezvousManager` :349).
+
+TPU redesign: a completed rendezvous yields the `jax.distributed` world —
+an ordered mapping node_rank → (node_id, local device count, ip, port) plus the
+coordinator address (rank-0's ip:free_port).  Agents use it to start
+`jax.distributed.initialize(coordinator, num_processes, process_id)` and build
+the global device mesh; on membership change the round advances and the world
+re-forms (restart-the-world elasticity, SURVEY.md §7 hard-part (a)).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from abc import ABC, abstractmethod
+from typing import Dict, List, Optional, Tuple
+
+from ..common.constants import NetworkFailureReason, RendezvousName
+from ..common.log import get_logger
+
+logger = get_logger("rendezvous")
+
+
+class NodeSpec:
+    """What a node declares when joining."""
+
+    def __init__(self, node_id: int, node_rank: int, local_world_size: int,
+                 node_ip: str = "", free_port: int = 0):
+        self.node_id = node_id
+        self.node_rank = node_rank
+        self.local_world_size = local_world_size
+        self.node_ip = node_ip
+        self.free_port = free_port
+        self.join_time = time.time()
+
+
+class RendezvousParameters:
+    def __init__(self, min_nodes: int, max_nodes: int,
+                 waiting_timeout: float = 30.0,
+                 join_timeout: float = 600.0):
+        self.min_nodes = min_nodes
+        self.max_nodes = max_nodes
+        # extra seconds to wait for stragglers once min_nodes have joined
+        self.waiting_timeout = waiting_timeout
+        self.join_timeout = join_timeout
+
+
+class RendezvousManager(ABC):
+    """Barrier forming the elastic communication world."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._params = RendezvousParameters(1, 1)
+        self._waiting_nodes: Dict[int, NodeSpec] = {}  # node_id -> spec
+        self._rdzv_world: Dict[int, NodeSpec] = {}  # node_rank -> spec
+        self._rdzv_round = 0
+        self._latest_rdzv_nodes: List[int] = []
+        self._start_rdzv_ts = 0.0
+        self._alive_nodes: set = set()
+        self._node_unit = 1
+
+    def update_rdzv_params(self, min_nodes: int, max_nodes: int,
+                           waiting_timeout: float = 30.0,
+                           join_timeout: float = 600.0, node_unit: int = 1):
+        with self._lock:
+            self._params = RendezvousParameters(min_nodes, max_nodes,
+                                                waiting_timeout, join_timeout)
+            self._node_unit = max(1, node_unit)
+
+    def get_rdzv_round(self) -> int:
+        return self._rdzv_round
+
+    def add_alive_node(self, node_id: int):
+        with self._lock:
+            self._alive_nodes.add(node_id)
+
+    def remove_alive_node(self, node_id: int):
+        with self._lock:
+            self._alive_nodes.discard(node_id)
+            if node_id in self._waiting_nodes:
+                del self._waiting_nodes[node_id]
+                logger.info("%s: removed dead waiting node %s", self.name,
+                            node_id)
+
+    def join_rendezvous(self, node_id: int, node_rank: int,
+                        local_world_size: int, node_ip: str = "",
+                        free_port: int = 0) -> int:
+        """Register a node as waiting; returns the current round."""
+        with self._lock:
+            if node_id not in self._waiting_nodes:
+                self._waiting_nodes[node_id] = NodeSpec(
+                    node_id, node_rank, local_world_size, node_ip, free_port)
+                if not self._start_rdzv_ts:
+                    self._start_rdzv_ts = time.time()
+                logger.info(
+                    "%s: node %s (rank hint %s) joined; waiting=%d round=%d",
+                    self.name, node_id, node_rank, len(self._waiting_nodes),
+                    self._rdzv_round)
+            self._alive_nodes.add(node_id)
+            return self._rdzv_round
+
+    def num_nodes_waiting(self) -> int:
+        """Nonzero signals agents that a re-rendezvous is pending.
+
+        Parity: reference agents poll this to trigger restart on membership
+        change (`training.py:711 _membership_changed`).
+        """
+        with self._lock:
+            # Only report when a *new* world could form (e.g. replacement node
+            # arrived while training) — mirrors reference semantics where
+            # waiting>0 triggers worker restart.
+            return len(self._waiting_nodes)
+
+    def _world_ready(self) -> bool:
+        n = len(self._waiting_nodes)
+        if n < self._params.min_nodes:
+            return False
+        if n >= self._params.max_nodes:
+            return True
+        # min reached: give stragglers a grace window
+        return (time.time() - self._start_rdzv_ts) > self._params.waiting_timeout
+
+    def _form_world(self):
+        specs = sorted(self._waiting_nodes.values(),
+                       key=lambda s: (s.node_rank, s.node_id))
+        n = len(specs)
+        if n > self._params.max_nodes:
+            specs = specs[: self._params.max_nodes]
+            n = len(specs)
+        # honor node_unit (e.g. TPU-slice granularity)
+        usable = (n // self._node_unit) * self._node_unit
+        specs = specs[:usable]
+        self._rdzv_world = {rank: spec for rank, spec in enumerate(specs)}
+        for spec in specs:
+            del self._waiting_nodes[spec.node_id]
+        self._latest_rdzv_nodes = [s.node_id for s in specs]
+        self._start_rdzv_ts = 0.0
+        self._rdzv_round += 1
+        logger.info("%s: formed world round=%d nodes=%s", self.name,
+                    self._rdzv_round, self._latest_rdzv_nodes)
+
+    @abstractmethod
+    def get_comm_world(self, node_id: int) -> Tuple[int, int, Dict[int, NodeSpec]]:
+        """Returns (round, group, world{node_rank: NodeSpec}); empty world if
+        not yet formed."""
+
+    def coordinator_addr(self) -> str:
+        with self._lock:
+            spec = self._rdzv_world.get(0)
+            if spec is None:
+                return ""
+            return f"{spec.node_ip or '127.0.0.1'}:{spec.free_port}"
+
+    def rdzv_timed_out(self) -> bool:
+        with self._lock:
+            return bool(
+                self._start_rdzv_ts
+                and time.time() - self._start_rdzv_ts
+                > self._params.join_timeout)
+
+
+class ElasticTrainingRendezvousManager(RendezvousManager):
+    """Parity: reference rdzv_manager.py:291."""
+
+    def __init__(self):
+        super().__init__(RendezvousName.ELASTIC_TRAINING)
+
+    def get_comm_world(self, node_id: int):
+        with self._lock:
+            if self._world_ready():
+                self._form_world()
+            if node_id in [s.node_id for s in self._rdzv_world.values()]:
+                return self._rdzv_round, 0, dict(self._rdzv_world)
+            return self._rdzv_round, 0, {}
+
+
+class NetworkCheckRendezvousManager(RendezvousManager):
+    """2-round pairwise-group diagnosis to isolate a fault node / straggler.
+
+    Parity: reference rdzv_manager.py:349-565 (`_group_nodes` :408,
+    `check_fault_node` :507, `get_straggler` :532).  Round 0 pairs neighbours
+    (0,1)(2,3)...; round 1 shifts the pairing so every node gets a different
+    partner; a node whose group fails in both rounds is the faulty one.  On TPU
+    the per-group workload is a matmul + ICI/DCN allgather benchmark
+    (`agent/node_check.py`).
+    """
+
+    def __init__(self):
+        super().__init__(RendezvousName.NETWORK_CHECK)
+        self._node_status: Dict[int, bool] = {}
+        self._node_times: Dict[int, float] = {}
+        self._check_round = 2
+        self._fault_nodes: List[int] = []
+        self._stragglers: List[int] = []
+
+    def get_comm_world(self, node_id: int):
+        with self._lock:
+            if self._world_ready():
+                self._form_world()
+            if not self._rdzv_world:
+                return self._rdzv_round, 0, {}
+            # rounds are 1-based after formation; first sweep pairs neighbours
+            groups = self._group_nodes(self._rdzv_round - 1)
+            for gi, group in enumerate(groups):
+                if node_id in [s.node_id for s in group.values()]:
+                    return self._rdzv_round, gi, group
+            return self._rdzv_round, 0, {}
+
+    def _group_nodes(self, rdzv_round: int) -> List[Dict[int, NodeSpec]]:
+        """Pair nodes; shift pairing on odd rounds so failures can be isolated."""
+        round_idx = rdzv_round % self._check_round
+        ranks = sorted(self._rdzv_world.keys())
+        groups: List[List[int]] = []
+        if round_idx == 0:
+            for i in range(0, len(ranks), 2):
+                groups.append(ranks[i:i + 2])
+        else:
+            if len(ranks) > 1:
+                groups.append([ranks[0], ranks[-1]])
+                middle = ranks[1:-1]
+                for i in range(0, len(middle), 2):
+                    groups.append(middle[i:i + 2])
+            else:
+                groups.append(ranks)
+        # merge a trailing singleton into the previous group
+        merged = []
+        for g in groups:
+            if len(g) == 1 and merged:
+                merged[-1].extend(g)
+            elif g:
+                merged.append(g)
+        return [
+            {rank: self._rdzv_world[rank] for rank in g} for g in merged
+        ]
+
+    def report_network_check_result(self, node_id: int, normal: bool,
+                                    elapsed_time: float):
+        with self._lock:
+            self._node_status[node_id] = (
+                self._node_status.get(node_id, False) or normal)
+            self._node_times[node_id] = min(
+                self._node_times.get(node_id, float("inf")), elapsed_time)
+
+    def join_rendezvous(self, node_id: int, node_rank: int,
+                        local_world_size: int, node_ip: str = "",
+                        free_port: int = 0) -> int:
+        with self._lock:
+            if not self._waiting_nodes:
+                # starting a fresh check sweep
+                self._node_status.clear()
+                self._node_times.clear()
+                self._fault_nodes.clear()
+                self._stragglers.clear()
+        return super().join_rendezvous(node_id, node_rank, local_world_size,
+                                       node_ip, free_port)
+
+    def network_check_success(self) -> Tuple[bool, str]:
+        """All nodes reported and none faulty."""
+        with self._lock:
+            if not self._node_status:
+                return False, NetworkFailureReason.NO_INIT
+            if len(self._node_status) < len(self._latest_rdzv_nodes):
+                return False, NetworkFailureReason.WAITING_NODE
+            if all(self._node_status.values()):
+                return True, ""
+            return False, NetworkFailureReason.NODE_FAILURE
+
+    def check_fault_node(self) -> Tuple[List[int], str]:
+        with self._lock:
+            if not self._node_status:
+                return [], NetworkFailureReason.NO_INIT
+            if len(self._node_status) < len(self._latest_rdzv_nodes):
+                return [], NetworkFailureReason.WAITING_NODE
+            self._fault_nodes = [
+                nid for nid, ok in self._node_status.items() if not ok
+            ]
+            reason = (NetworkFailureReason.NODE_FAILURE
+                      if self._fault_nodes else "")
+            return list(self._fault_nodes), reason
+
+    def get_straggler(self, threshold: float = 2.0) -> Tuple[List[int], str]:
+        """Nodes slower than `threshold`× the median benchmark time."""
+        with self._lock:
+            times = {nid: t for nid, t in self._node_times.items()
+                     if t != float("inf")}
+            if len(times) < 2:
+                return [], ""
+            ordered = sorted(times.values())
+            median = ordered[len(ordered) // 2]
+            if median <= 0:
+                return [], ""
+            self._stragglers = [
+                nid for nid, t in times.items() if t > threshold * median
+            ]
+            return list(self._stragglers), ""

@@ -1,0 +1,212 @@
+"""RPC dispatch: single get/report envelope over all master services.
+
+Parity: reference `dlrover/python/master/servicer.py` (`MasterServicer.get` :98,
+`.report` :296) — dispatch keyed on message type.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+from ..common import messages as msg
+from ..common.comm import RpcServer
+from ..common.log import get_logger
+from ..common.node import Node, NodeEvent
+from ..common.constants import NodeEventType, NodeStatus
+
+logger = get_logger("servicer")
+
+
+class MasterServicer:
+    def __init__(self, job_master):
+        self.m = job_master
+
+    # --------------------------------------------------------------- dispatch
+
+    def handle(self, verb: str, node_id: int, node_type: str,
+               payload: Any) -> Any:
+        if verb == "get":
+            return self._get(node_id, node_type, payload)
+        return self._report(node_id, node_type, payload)
+
+    def _get(self, node_id: int, node_type: str, payload: Any) -> Any:
+        m = self.m
+        if isinstance(payload, msg.TaskRequest):
+            task = m.task_manager.get_dataset_task(node_id,
+                                                   payload.dataset_name)
+            if task is None:
+                finished = m.task_manager.finished(payload.dataset_name)
+                return msg.Task(
+                    task_id=-1,
+                    task_type="none" if finished else "wait",
+                    dataset_name=payload.dataset_name)
+            return msg.Task(
+                task_id=task.task_id, task_type=task.task_type,
+                shard=msg.ShardConfig(start=task.shard.start,
+                                      end=task.shard.end,
+                                      indices=task.shard.record_indices),
+                dataset_name=payload.dataset_name)
+
+        if isinstance(payload, msg.CommWorldRequest):
+            rdzv = m.rdzv_managers.get(payload.rdzv_name)
+            rdzv_round, group, world = rdzv.get_comm_world(payload.node_id)
+            state = msg.RendezvousState(rdzv_round=rdzv_round, group=group)
+            if world:
+                state.world = {
+                    str(rank): [s.node_id, s.local_world_size, s.node_ip,
+                                s.free_port]
+                    for rank, s in world.items()
+                }
+                state.coordinator_addr = rdzv.coordinator_addr()
+                state.complete = True
+            return state
+
+        if isinstance(payload, msg.WaitingNodeNumRequest):
+            rdzv = m.rdzv_managers.get(payload.rdzv_name)
+            return msg.WaitingNodeNumResponse(
+                waiting_num=rdzv.num_nodes_waiting())
+
+        if isinstance(payload, msg.NetworkReadyRequest):
+            rdzv = m.rdzv_managers.get("network-check")
+            success, reason = rdzv.network_check_success()
+            return msg.OkResponse(success=success, reason=reason)
+
+        if isinstance(payload, msg.StragglerExistRequest):
+            rdzv = m.rdzv_managers.get("network-check")
+            stragglers, reason = rdzv.get_straggler()
+            return msg.NetworkStatusResponse(nodes=stragglers, reason=reason)
+
+        if isinstance(payload, msg.KVStoreGetRequest):
+            value = m.kv_store.get(payload.key)
+            return msg.KVStoreResponse(found=value is not None,
+                                       value=value or b"")
+
+        if isinstance(payload, msg.KVStoreMultiGetRequest):
+            values = m.kv_store.multi_get(payload.keys)
+            if any(v is None for v in values):
+                return msg.KVStoreResponse(found=False)
+            return msg.KVStoreResponse(found=True, values=values)
+
+        if isinstance(payload, msg.KVStoreAddRequest):
+            num = m.kv_store.add(payload.key, payload.amount)
+            return msg.KVStoreResponse(found=True, num=num)
+
+        if isinstance(payload, msg.ShardCheckpointRequest):
+            content = m.task_manager.get_dataset_checkpoint(
+                payload.dataset_name)
+            return msg.ShardCheckpoint(content=content)
+
+        if isinstance(payload, msg.ParallelConfigRequest):
+            return m.get_paral_config(payload.node_id)
+
+        raise ValueError(f"unknown get message: {type(payload).__name__}")
+
+    def _report(self, node_id: int, node_type: str, payload: Any) -> Any:
+        m = self.m
+        if isinstance(payload, msg.JoinRendezvousRequest):
+            rdzv = m.rdzv_managers.get(payload.rdzv_name)
+            rdzv_round = rdzv.join_rendezvous(
+                payload.node_id, payload.node_rank, payload.local_world_size,
+                payload.node_ip, payload.free_port)
+            m.job_manager.register_node("worker", payload.node_id,
+                                        rank_index=payload.node_rank)
+            m.job_manager.collect_heartbeat(payload.node_id)
+            return msg.RendezvousState(rdzv_round=rdzv_round)
+
+        if isinstance(payload, msg.TaskResult):
+            success = not payload.err_message
+            m.task_manager.report_dataset_task(
+                node_id, payload.dataset_name, payload.task_id, success)
+            return msg.OkResponse()
+
+        if isinstance(payload, msg.DatasetShardParams):
+            m.task_manager.new_dataset(
+                batch_size=payload.batch_size,
+                dataset_size=payload.dataset_size,
+                dataset_name=payload.dataset_name,
+                num_epochs=payload.num_epochs,
+                shuffle=payload.shuffle,
+                num_minibatches_per_shard=payload.num_minibatches_per_shard,
+                storage_type=payload.storage_type,
+                task_type=payload.task_type)
+            return msg.OkResponse()
+
+        if isinstance(payload, msg.HeartBeat):
+            action = m.job_manager.collect_heartbeat(payload.node_id,
+                                                     payload.timestamp)
+            if payload.global_step:
+                m.speed_monitor.collect_global_step(payload.global_step,
+                                                    payload.timestamp)
+            return msg.HeartbeatResponse(action=action)
+
+        if isinstance(payload, msg.NodeMeta):
+            node = m.job_manager.register_node(
+                payload.node_type, payload.node_id,
+                rank_index=payload.node_rank, addr=payload.addr)
+            node.config_resource.cpu = payload.cpu
+            node.config_resource.memory_mb = payload.memory_mb
+            node.config_resource.accelerator_type = payload.accelerator_type
+            node.config_resource.accelerator_num = payload.accelerator_num
+            return msg.OkResponse()
+
+        if isinstance(payload, msg.NetworkCheckResult):
+            rdzv = m.rdzv_managers.get("network-check")
+            rdzv.report_network_check_result(
+                payload.node_id, payload.normal, payload.elapsed_time)
+            return msg.OkResponse()
+
+        if isinstance(payload, msg.GlobalStep):
+            m.speed_monitor.collect_global_step(payload.step,
+                                                payload.timestamp)
+            return msg.OkResponse()
+
+        if isinstance(payload, msg.NodeFailure):
+            node = Node("worker", payload.node_id)
+            node.status = NodeStatus.FAILED
+            node.exit_reason = payload.error_data or "UnknownError"
+            m.job_manager.process_event(NodeEvent(NodeEventType.MODIFIED,
+                                                  node))
+            m.task_manager.recover_tasks(payload.node_id)
+            for rdzv in m.rdzv_managers.values():
+                rdzv.remove_alive_node(payload.node_id)
+            return msg.OkResponse()
+
+        if isinstance(payload, msg.NodeEventReport):
+            logger.info("node event from %s: %s %s", payload.node_id,
+                        payload.event_type, payload.message)
+            m.record_node_event(payload)
+            return msg.OkResponse()
+
+        if isinstance(payload, msg.KVStoreSetRequest):
+            m.kv_store.set(payload.key, payload.value)
+            return msg.OkResponse()
+
+        if isinstance(payload, msg.ShardCheckpoint):
+            ok = m.task_manager.restore_dataset_from_checkpoint(
+                payload.content)
+            return msg.OkResponse(success=ok)
+
+        if isinstance(payload, msg.ResourceStats):
+            node = m.job_manager.get_node(payload.node_id)
+            if node is not None:
+                node.update_resource_usage(payload.cpu_percent,
+                                           payload.memory_mb,
+                                           payload.accelerator_stats)
+            return msg.OkResponse()
+
+        if isinstance(payload, (msg.ModelInfo, msg.CustomMetric)):
+            m.collect_custom_data(payload)
+            return msg.OkResponse()
+
+        if isinstance(payload, msg.DiagnosisReport):
+            return m.diagnosis_manager.collect_report(payload)
+
+        raise ValueError(f"unknown report message: {type(payload).__name__}")
+
+
+def create_master_service(job_master, host: str = "0.0.0.0",
+                          port: int = 0) -> RpcServer:
+    """Parity: reference servicer.py:630 create_master_service."""
+    servicer = MasterServicer(job_master)
+    return RpcServer(servicer.handle, host=host, port=port)

@@ -1,0 +1,213 @@
+"""Dynamic data sharding: shard queue, dispatch, recovery of failed-worker shards.
+
+Parity: reference `dlrover/python/master/shard/task_manager.py` (TaskManager :37,
+new_dataset :59, doing/done queues) + `{batch,streaming}_dataset_manager.py`.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from ..common.constants import TaskType
+from ..common.log import get_logger
+from .dataset_splitter import DatasetSplitter, Shard, new_dataset_splitter
+
+logger = get_logger("task_manager")
+
+
+@dataclass
+class DatasetTask:
+    task_id: int
+    task_type: str
+    shard: Shard
+
+
+@dataclass
+class DoingTask:
+    task: DatasetTask
+    node_id: int
+    start_time: float
+
+
+class DatasetManager:
+    """Todo/doing/done bookkeeping for one named dataset."""
+
+    def __init__(self, task_type: str, batch_size: int,
+                 splitter: DatasetSplitter):
+        self.task_type = task_type
+        self.batch_size = batch_size
+        self.splitter = splitter
+        self.todo: List[DatasetTask] = []
+        self.doing: Dict[int, DoingTask] = {}
+        self._task_id = 0
+        self._completed_step = 0
+
+    def create_tasks(self):
+        self.splitter.create_shards()
+        for shard in self.splitter.get_shards():
+            self.todo.append(DatasetTask(self._task_id, self.task_type, shard))
+            self._task_id += 1
+
+    def get_task(self, node_id: int) -> Optional[DatasetTask]:
+        if not self.todo:
+            if not self.splitter.epoch_finished():
+                self.create_tasks()
+        if not self.todo:
+            return None
+        task = self.todo.pop(0)
+        self.doing[task.task_id] = DoingTask(task, node_id, time.time())
+        return task
+
+    def report_task_done(self, task_id: int, success: bool) -> bool:
+        doing = self.doing.pop(task_id, None)
+        if doing is None:
+            return False
+        if not success:
+            self.todo.insert(0, doing.task)
+            return False
+        return True
+
+    def recover_node_tasks(self, node_id: int) -> int:
+        """Re-queue shards a dead worker was processing (shard-level recovery)."""
+        recovered = [tid for tid, d in self.doing.items()
+                     if d.node_id == node_id]
+        for tid in recovered:
+            doing = self.doing.pop(tid)
+            self.todo.insert(0, doing.task)
+        if recovered:
+            logger.info("recovered %d in-flight shards from node %s",
+                        len(recovered), node_id)
+        return len(recovered)
+
+    def completed(self) -> bool:
+        return (not self.todo and not self.doing
+                and self.splitter.epoch_finished())
+
+    def to_checkpoint(self) -> Dict:
+        return {
+            "splitter": self.splitter.to_checkpoint(),
+            "task_type": self.task_type,
+            "batch_size": self.batch_size,
+            "todo": [[t.shard.start, t.shard.end, t.shard.record_indices]
+                     for t in self.todo]
+                    + [[d.task.shard.start, d.task.shard.end,
+                        d.task.shard.record_indices]
+                       for d in self.doing.values()],
+        }
+
+    @classmethod
+    def from_checkpoint(cls, data: Dict) -> "DatasetManager":
+        splitter = DatasetSplitter.from_checkpoint(data["splitter"])
+        mgr = cls(data["task_type"], data["batch_size"], splitter)
+        for start, end, indices in data.get("todo", []):
+            mgr.todo.append(
+                DatasetTask(mgr._task_id, mgr.task_type,
+                            Shard(splitter.dataset_name, start, end,
+                                  indices or [])))
+            mgr._task_id += 1
+        return mgr
+
+
+class TaskManager:
+    """Dispatches dataset shards to workers; detects task hang.
+
+    Parity: reference task_manager.py:37 (+ `reset_worker_start_task_time`
+    hang signal used by the diagnosis subsystem).
+    """
+
+    def __init__(self, worker_restart_timeout: float = 0.0):
+        self._lock = threading.Lock()
+        self._datasets: Dict[str, DatasetManager] = {}
+        self._worker_start_task_time: Dict[int, float] = {}
+        self._task_timeout_callbacks: List[Callable] = []
+        self._worker_restart_timeout = worker_restart_timeout
+        self.speed_monitor = None  # wired by the master
+
+    def new_dataset(self, batch_size: int, dataset_size: int,
+                    dataset_name: str, num_epochs: int = 1,
+                    shuffle: bool = False,
+                    num_minibatches_per_shard: int = 2,
+                    storage_type: str = "",
+                    task_type: str = TaskType.TRAINING):
+        with self._lock:
+            if dataset_name in self._datasets:
+                return
+            splitter = new_dataset_splitter(
+                storage_type, shuffle, dataset_size, batch_size, num_epochs,
+                num_minibatches_per_shard, dataset_name)
+            mgr = DatasetManager(task_type, batch_size, splitter)
+            mgr.create_tasks()
+            self._datasets[dataset_name] = mgr
+            logger.info("new dataset %s: size=%d shards=%d", dataset_name,
+                        dataset_size, len(mgr.todo))
+
+    def get_dataset_task(self, node_id: int,
+                         dataset_name: str) -> Optional[DatasetTask]:
+        with self._lock:
+            mgr = self._datasets.get(dataset_name)
+            if mgr is None:
+                return None
+            task = mgr.get_task(node_id)
+            if task is not None:
+                self._worker_start_task_time[node_id] = time.time()
+            return task
+
+    def report_dataset_task(self, node_id: int, dataset_name: str,
+                            task_id: int, success: bool) -> bool:
+        with self._lock:
+            mgr = self._datasets.get(dataset_name)
+            if mgr is None:
+                return False
+            self._worker_start_task_time[node_id] = time.time()
+            return mgr.report_task_done(task_id, success)
+
+    def recover_tasks(self, node_id: int):
+        with self._lock:
+            for mgr in self._datasets.values():
+                mgr.recover_node_tasks(node_id)
+
+    def finished(self, dataset_name: Optional[str] = None) -> bool:
+        with self._lock:
+            if dataset_name:
+                mgr = self._datasets.get(dataset_name)
+                return mgr.completed() if mgr else True
+            return all(m.completed() for m in self._datasets.values())
+
+    def reset_worker_start_task_time(self, node_id: int):
+        with self._lock:
+            self._worker_start_task_time[node_id] = time.time()
+
+    def task_hanged(self, timeout: float = 1800.0) -> bool:
+        """True if every worker with in-flight tasks is silent past timeout."""
+        with self._lock:
+            doing_nodes = set()
+            for mgr in self._datasets.values():
+                doing_nodes.update(d.node_id for d in mgr.doing.values())
+            if not doing_nodes:
+                return False
+            now = time.time()
+            return all(
+                now - self._worker_start_task_time.get(nid, now) > timeout
+                for nid in doing_nodes)
+
+    def get_dataset_checkpoint(self, dataset_name: str) -> str:
+        with self._lock:
+            mgr = self._datasets.get(dataset_name)
+            if mgr is None:
+                return ""
+            return json.dumps(mgr.to_checkpoint())
+
+    def restore_dataset_from_checkpoint(self, content: str) -> bool:
+        try:
+            data = json.loads(content)
+            mgr = DatasetManager.from_checkpoint(data)
+            with self._lock:
+                self._datasets[mgr.splitter.dataset_name] = mgr
+            return True
+        except (ValueError, KeyError) as e:
+            logger.warning("failed to restore dataset checkpoint: %s", e)
+            return False

@@ -1,0 +1,276 @@
+"""Master-layer tests: rendezvous state machine, dynamic sharding, servicer loop.
+
+Mirrors reference tests `test_rdzv_manager.py`, `test_task_manager.py`,
+`test_servicer.py`, `test_speed_monitor.py` — real master objects, no cluster.
+"""
+
+import time
+
+import pytest
+
+from dlrover_wuqiong_tpu.agent.master_client import MasterClient
+from dlrover_wuqiong_tpu.agent.sharding_client import (
+    IndexShardingClient,
+    ShardingClient,
+)
+from dlrover_wuqiong_tpu.common.constants import NodeStatus, RendezvousName
+from dlrover_wuqiong_tpu.master.dataset_splitter import (
+    DatasetSplitter,
+    StreamingDatasetSplitter,
+    TableDatasetSplitter,
+    TextDatasetSplitter,
+)
+from dlrover_wuqiong_tpu.master.master import JobMaster
+from dlrover_wuqiong_tpu.master.rendezvous import (
+    ElasticTrainingRendezvousManager,
+    NetworkCheckRendezvousManager,
+)
+from dlrover_wuqiong_tpu.master.speed_monitor import SpeedMonitor
+from dlrover_wuqiong_tpu.master.task_manager import TaskManager
+
+
+class TestElasticRendezvous:
+    def test_world_forms_at_min_nodes(self):
+        rdzv = ElasticTrainingRendezvousManager()
+        rdzv.update_rdzv_params(2, 4, waiting_timeout=0.0)
+        rdzv.join_rendezvous(0, 0, 4, node_ip="10.0.0.1", free_port=1234)
+        rnd, grp, world = rdzv.get_comm_world(0)
+        assert world == {}  # only 1 node
+        rdzv.join_rendezvous(1, 1, 4, node_ip="10.0.0.2", free_port=1235)
+        time.sleep(0.01)
+        rnd, grp, world = rdzv.get_comm_world(0)
+        assert len(world) == 2
+        assert world[0].node_id == 0 and world[1].node_id == 1
+        assert rdzv.coordinator_addr() == "10.0.0.1:1234"
+        # same world returned to the other member
+        rnd2, _, world2 = rdzv.get_comm_world(1)
+        assert rnd2 == rnd and len(world2) == 2
+
+    def test_rejoin_advances_round(self):
+        rdzv = ElasticTrainingRendezvousManager()
+        rdzv.update_rdzv_params(2, 2, waiting_timeout=0.0)
+        for nid in (0, 1):
+            rdzv.join_rendezvous(nid, nid, 1)
+        rnd, _, world = rdzv.get_comm_world(0)
+        assert rnd == 1 and len(world) == 2
+        # node 1 dies; replacement node 2 joins, node 0 rejoins
+        rdzv.remove_alive_node(1)
+        rdzv.join_rendezvous(2, 1, 1)
+        assert rdzv.num_nodes_waiting() == 1
+        rdzv.join_rendezvous(0, 0, 1)
+        rnd, _, world = rdzv.get_comm_world(0)
+        assert rnd == 2 and len(world) == 2
+        ids = {s.node_id for s in world.values()}
+        assert ids == {0, 2}
+
+    def test_node_unit_truncates(self):
+        rdzv = ElasticTrainingRendezvousManager()
+        rdzv.update_rdzv_params(2, 8, waiting_timeout=0.0, node_unit=2)
+        for nid in range(3):
+            rdzv.join_rendezvous(nid, nid, 1)
+        _, _, world = rdzv.get_comm_world(0)
+        assert len(world) == 2  # truncated to multiple of node_unit
+
+
+class TestNetworkCheckRendezvous:
+    def _form(self, n):
+        rdzv = NetworkCheckRendezvousManager()
+        rdzv.update_rdzv_params(n, n, waiting_timeout=0.0)
+        for nid in range(n):
+            rdzv.join_rendezvous(nid, nid, 1)
+        return rdzv
+
+    def test_pair_groups_round0(self):
+        rdzv = self._form(4)
+        _, g0, w0 = rdzv.get_comm_world(0)
+        _, g1, w1 = rdzv.get_comm_world(1)
+        assert g0 == g1 and len(w0) == 2
+        _, g2, _ = rdzv.get_comm_world(2)
+        assert g2 != g0
+
+    def test_fault_isolation_two_rounds(self):
+        rdzv = self._form(4)
+        # round 1: node 3 faulty → its pair group (2,3) both report failure
+        for nid, ok in [(0, True), (1, True), (2, False), (3, False)]:
+            rdzv.report_network_check_result(nid, ok, 1.0)
+        success, _ = rdzv.network_check_success()
+        assert not success
+        faults, reason = rdzv.check_fault_node()
+        assert set(faults) == {2, 3}
+        # round 2: shifted grouping — 2 paired with a healthy node passes,
+        # 3 still fails; status ORs across rounds → only 3 remains faulty
+        for nid in range(4):
+            rdzv.join_rendezvous(nid, nid, 1)
+        rdzv.get_comm_world(0)
+        for nid, ok in [(0, True), (1, True), (2, True), (3, False)]:
+            rdzv.report_network_check_result(nid, ok, 1.0)
+        faults, _ = rdzv.check_fault_node()
+        assert faults == [3]
+
+    def test_straggler_detection(self):
+        rdzv = self._form(4)
+        for nid, t in [(0, 1.0), (1, 1.1), (2, 0.9), (3, 5.0)]:
+            rdzv.report_network_check_result(nid, True, t)
+        stragglers, _ = rdzv.get_straggler(threshold=2.0)
+        assert stragglers == [3]
+
+
+class TestDatasetSplitters:
+    def test_table_splitter(self):
+        sp = TableDatasetSplitter("ds", 100, 30)
+        sp.create_shards()
+        shards = sp.get_shards()
+        assert [s.start for s in shards] == [0, 30, 60, 90]
+        assert shards[-1].end == 100
+
+    def test_text_splitter_indices(self):
+        sp = TextDatasetSplitter("ds", 10, 4, shuffle=True)
+        sp.create_shards()
+        all_indices = [i for s in sp.get_shards() for i in s.record_indices]
+        assert sorted(all_indices) == list(range(10))
+
+    def test_streaming_checkpoint_roundtrip(self):
+        sp = StreamingDatasetSplitter("ds", 100, fetch_data_size=300)
+        sp.create_shards()
+        ckpt = sp.to_checkpoint()
+        sp2 = DatasetSplitter.from_checkpoint(ckpt)
+        assert sp2.partition_offset == 300
+        assert len(sp2.get_shards()) == 3
+
+
+class TestTaskManager:
+    def test_dispatch_and_recover(self):
+        tm = TaskManager()
+        tm.new_dataset(batch_size=10, dataset_size=100, dataset_name="d",
+                       num_minibatches_per_shard=2)
+        t1 = tm.get_dataset_task(0, "d")
+        t2 = tm.get_dataset_task(1, "d")
+        assert t1.task_id != t2.task_id
+        assert tm.report_dataset_task(0, "d", t1.task_id, True)
+        # worker 1 dies: its shard is requeued at the front
+        tm.recover_tasks(1)
+        t3 = tm.get_dataset_task(2, "d")
+        assert t3.shard.start == t2.shard.start
+        assert not tm.finished("d")
+
+    def test_finish_epoch(self):
+        tm = TaskManager()
+        tm.new_dataset(batch_size=10, dataset_size=20, dataset_name="d",
+                       num_minibatches_per_shard=1)
+        seen = 0
+        while True:
+            t = tm.get_dataset_task(0, "d")
+            if t is None:
+                break
+            seen += 1
+            tm.report_dataset_task(0, "d", t.task_id, True)
+        assert seen == 2
+        assert tm.finished("d")
+
+    def test_checkpoint_roundtrip(self):
+        tm = TaskManager()
+        tm.new_dataset(batch_size=5, dataset_size=50, dataset_name="d")
+        t = tm.get_dataset_task(0, "d")
+        ckpt = tm.get_dataset_checkpoint("d")
+        tm2 = TaskManager()
+        assert tm2.restore_dataset_from_checkpoint(ckpt)
+        # in-flight shard is back in todo
+        starts = set()
+        while True:
+            task = tm2.get_dataset_task(0, "d")
+            if task is None:
+                break
+            starts.add(task.shard.start)
+        assert t.shard.start in starts
+
+
+class TestSpeedMonitor:
+    def test_running_speed(self):
+        sm = SpeedMonitor()
+        t0 = time.time()
+        for i in range(10):
+            sm.collect_global_step(i * 10, t0 + i)
+        assert sm.completed_global_step == 90
+        assert abs(sm.running_speed() - 10.0) < 0.01
+
+
+class TestMasterEndToEnd:
+    """In-process master + RPC clients (reference test_elastic_training_agent
+    style)."""
+
+    @pytest.fixture()
+    def master(self):
+        m = JobMaster(min_nodes=2, max_nodes=2)
+        m.prepare()
+        yield m
+        m.stop()
+        MasterClient.reset()
+
+    def test_rendezvous_over_rpc(self, master):
+        c0 = MasterClient(master.addr, node_id=0)
+        c1 = MasterClient(master.addr, node_id=1)
+        c0.register_node(0, accelerator_num=4)
+        c1.register_node(1, accelerator_num=4)
+        c0.join_rendezvous(0, 4, node_ip="127.0.0.1", free_port=4000)
+        c1.join_rendezvous(1, 4, node_ip="127.0.0.1", free_port=4001)
+        state = c0.get_comm_world()
+        assert state.complete
+        assert state.coordinator_addr == "127.0.0.1:4000"
+        assert len(state.world) == 2
+        # world maps str(rank) -> [node_id, local_world_size, ip, port]
+        assert state.world["0"][0] == 0
+        assert state.world["0"][1] == 4
+
+    def test_sharding_over_rpc(self, master):
+        c0 = MasterClient(master.addr, node_id=0)
+        sc = ShardingClient(c0, "train", batch_size=4, dataset_size=40,
+                            num_minibatches_per_shard=1)
+        count = 0
+        while True:
+            task = sc.fetch_shard(wait=False)
+            if task is None:
+                break
+            count += 1
+            sc.report_shard_done()
+        assert count == 10
+
+    def test_index_sharding_client(self, master):
+        c0 = MasterClient(master.addr, node_id=0)
+        sc = IndexShardingClient(c0, "train2", batch_size=4, dataset_size=20,
+                                 num_minibatches_per_shard=1)
+        indices = []
+        while True:
+            idx = sc.fetch_sample_index()
+            if idx is None:
+                break
+            indices.append(idx)
+            sc.report_batch_done(1)
+        assert sorted(indices) == list(range(20))
+
+    def test_kv_store_and_heartbeat(self, master):
+        c0 = MasterClient(master.addr, node_id=0)
+        c0.register_node(0)
+        c0.kv_store_set("k", b"v1")
+        assert c0.kv_store_get("k") == b"v1"
+        assert c0.kv_store_get("missing") is None
+        assert c0.kv_store_add("cnt", 5) == 5
+        assert c0.kv_store_add("cnt", 2) == 7
+        action = c0.report_heart_beat(global_step=10)
+        assert action == ""
+        assert master.speed_monitor.completed_global_step == 10
+
+    def test_failure_report_recovers_tasks(self, master):
+        c0 = MasterClient(master.addr, node_id=0)
+        c1 = MasterClient(master.addr, node_id=1)
+        c0.register_node(0)
+        c1.register_node(1)
+        sc = ShardingClient(c1, "d3", batch_size=5, dataset_size=50)
+        task = sc.fetch_shard()
+        assert task is not None
+        c1.report_failure("SIGKILL", level="node")
+        node = master.job_manager.get_node(1)
+        assert node.status in (NodeStatus.FAILED, NodeStatus.RUNNING)
+        # shard recovered: another worker can fetch the same start
+        sc0 = ShardingClient(c0, "d3", batch_size=5, dataset_size=50)
+        t2 = sc0.fetch_shard()
+        assert t2.shard.start == task.shard.start
