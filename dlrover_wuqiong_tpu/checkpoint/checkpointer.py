@@ -1,0 +1,71 @@
+"""User-facing flash-checkpoint API.
+
+Parity: reference `trainer/torch/flash_checkpoint/checkpointer.py`
+(Checkpointer ABC + StorageType :18-54) and the per-framework checkpointers
+(ddp.py / fsdp.py / ...).  In JAX one checkpointer covers every parallelism
+because state is always a sharded pytree; sharding metadata travels with the
+arrays, so the DDP/FSDP/Megatron/DeepSpeed split collapses into one class.
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import Any, Dict, Optional
+
+from ..common.log import get_logger
+from .engine import CheckpointEngine, restore_pytree
+
+logger = get_logger("checkpointer")
+
+
+class StorageType(Enum):
+    MEMORY = 0
+    DISK = 1
+
+
+class FlashCheckpointer:
+    """Sub-second blocking saves of sharded JAX train state.
+
+    Usage:
+        ckpt = FlashCheckpointer("/ckpts/run1")
+        ckpt.save_checkpoint(step, {"params": params, "opt": opt_state},
+                             storage_type=StorageType.DISK)
+        state = ckpt.load_checkpoint({"params": params, "opt": opt_state})
+    """
+
+    def __init__(self, checkpoint_dir: str, local_rank: int = 0,
+                 job_name: str = "dwt", node_rank: int = 0,
+                 local_shard_num: int = 1,
+                 standalone: Optional[bool] = None):
+        self.engine = CheckpointEngine(
+            checkpoint_dir, local_rank=local_rank, job_name=job_name,
+            node_rank=node_rank, local_shard_num=local_shard_num,
+            standalone=standalone)
+        self.checkpoint_dir = checkpoint_dir
+
+    def save_checkpoint(self, step: int, state: Any,
+                        storage_type: StorageType = StorageType.DISK,
+                        path: Optional[str] = None,
+                        extra_meta: Optional[Dict] = None) -> float:
+        """Returns seconds training was blocked."""
+        if storage_type == StorageType.MEMORY:
+            return self.engine.save_to_memory(step, state, extra_meta)
+        return self.engine.save_to_storage(step, state, path, extra_meta)
+
+    def load_checkpoint(self, template: Any,
+                        path: Optional[str] = None,
+                        step: Optional[int] = None) -> Optional[Any]:
+        """Restore into `template`'s structure/shardings; None if no ckpt."""
+        flat = self.engine.load(path, step)
+        if flat is None:
+            return None
+        return restore_pytree(template, flat)
+
+    def last_step(self) -> int:
+        return self.engine.latest_step()
+
+    def wait_latest_checkpoint(self, timeout: float = 600.0) -> bool:
+        return self.engine.wait_saving_latest(timeout)
+
+    def close(self):
+        self.engine.close()

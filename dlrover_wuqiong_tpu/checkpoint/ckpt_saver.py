@@ -1,0 +1,303 @@
+"""Agent-side async checkpoint persistence daemon.
+
+Parity: reference `elastic_agent/torch/ckpt_saver.py` (`AsyncCheckpointSaver`
+:344, `_save_shard` :544, `save_shm_to_storage` :634, `CommonDirCheckpointSaver`
+:773 commit protocol with done-files + tracker file).
+
+Flow (SURVEY.md §3.3): training procs stage shards in shm via
+`SharedMemoryHandler` and enqueue a `CheckpointEvent` on the shared queue; this
+daemon (running in the agent process) drains events, streams shm → storage with
+a threadpool, then atomically commits the step by writing done-files and the
+tracker file.  On worker failure the agent calls `save_shm_to_storage` so the
+last in-memory checkpoint survives the restart.
+
+Directory layout per step:
+    {path}/checkpoint-{step}/meta_rank{r}.json
+    {path}/checkpoint-{step}/shards_rank{r}.bin
+    {path}/checkpoint-{step}/.done/rank{r}.done
+    {path}/latest_checkpointed_iteration.txt         (commit marker)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional
+
+from ..common.constants import CheckpointConstant
+from ..common.log import get_logger
+from ..common.multi_process import SharedQueue
+from ..common.storage import CheckpointStorage, get_checkpoint_storage
+from .shm_handler import SharedMemoryHandler
+
+logger = get_logger("ckpt_saver")
+
+_SAVE_EVENT = "save"
+_UPDATE_SHARDS_EVENT = "update_shards"
+_EXIT_EVENT = "exit"
+
+
+def step_dir(path: str, step: int) -> str:
+    return os.path.join(path, f"{CheckpointConstant.CKPT_NAME_PREFIX}{step}")
+
+
+class CheckpointEvent:
+    @staticmethod
+    def save(step: int, path: str) -> Dict:
+        return {"type": _SAVE_EVENT, "step": step, "path": path}
+
+    @staticmethod
+    def update_shards(num: int) -> Dict:
+        return {"type": _UPDATE_SHARDS_EVENT, "num": num}
+
+    @staticmethod
+    def exit() -> Dict:
+        return {"type": _EXIT_EVENT}
+
+
+class AsyncCheckpointSaver:
+    """Singleton daemon inside the agent process."""
+
+    _instance: Optional["AsyncCheckpointSaver"] = None
+    _cls_lock = threading.Lock()
+
+    def __init__(self, job_name: str = "dwt", local_shard_num: int = 1,
+                 node_rank: int = 0,
+                 storage: Optional[CheckpointStorage] = None):
+        self.job_name = job_name
+        self.node_rank = node_rank
+        self.local_shard_num = local_shard_num
+        self.storage = storage or get_checkpoint_storage()
+        self._event_queue = SharedQueue(f"{job_name}-ckpt-events", master=True)
+        self._shm_handlers: Dict[int, SharedMemoryHandler] = {
+            r: SharedMemoryHandler(r, job_name)
+            for r in range(local_shard_num)
+        }
+        self._executor = ThreadPoolExecutor(
+            max_workers=max(4, local_shard_num), thread_name_prefix="ckpt-io")
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = threading.Event()
+        self._last_persisted_step = -1
+        self._latest_shm_step = -1
+        self._latest_path = ""
+
+    # ---------------------------------------------------------------- factory
+
+    @classmethod
+    def start_async_saving_ckpt(cls, job_name: str = "dwt",
+                                local_shard_num: int = 1,
+                                node_rank: int = 0,
+                                storage: Optional[CheckpointStorage] = None
+                                ) -> "AsyncCheckpointSaver":
+        """Parity: reference ckpt_saver.py:410."""
+        with cls._cls_lock:
+            if cls._instance is None:
+                cls._instance = cls(job_name, local_shard_num, node_rank,
+                                    storage)
+                cls._instance.start()
+            return cls._instance
+
+    @classmethod
+    def get_ckpt_saver(cls) -> Optional["AsyncCheckpointSaver"]:
+        return cls._instance
+
+    @classmethod
+    def reset(cls):
+        with cls._cls_lock:
+            if cls._instance is not None:
+                cls._instance.stop()
+                cls._instance = None
+
+    # ------------------------------------------------------------------ loop
+
+    def start(self):
+        self._thread = threading.Thread(target=self._sync_shm_to_storage,
+                                        daemon=True, name="dwt-ckpt-saver")
+        self._thread.start()
+
+    def stop(self):
+        self._stopped.set()
+        try:
+            self._event_queue.put(CheckpointEvent.exit())
+        except Exception:  # noqa: BLE001
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        for h in self._shm_handlers.values():
+            h.close()
+        self._event_queue.close()
+        self._executor.shutdown(wait=False)
+
+    def _sync_shm_to_storage(self):
+        """Parity: reference `_sync_shm_to_storage` :517."""
+        while not self._stopped.is_set():
+            try:
+                event = self._event_queue.get(timeout=1.0)
+            except Exception:  # queue.Empty
+                continue
+            etype = event.get("type")
+            if etype == _EXIT_EVENT:
+                return
+            if etype == _UPDATE_SHARDS_EVENT:
+                self._update_shard_num(event["num"])
+                continue
+            if etype == _SAVE_EVENT:
+                try:
+                    self.save_step_checkpoint(event["step"], event["path"])
+                except Exception:  # noqa: BLE001
+                    logger.exception("async save of step %s failed",
+                                     event.get("step"))
+
+    def _update_shard_num(self, num: int):
+        for h in self._shm_handlers.values():
+            h.close()
+        self.local_shard_num = num
+        self._shm_handlers = {
+            r: SharedMemoryHandler(r, self.job_name) for r in range(num)
+        }
+
+    # ------------------------------------------------------------------ save
+
+    def save_step_checkpoint(self, step: int, path: str):
+        """Persist all local shards of `step` then commit."""
+        start = time.time()
+        sdir = step_dir(path, step)
+        self.storage.safe_makedirs(os.path.join(sdir,
+                                                CheckpointConstant.DONE_DIR))
+        futures = []
+        for local_rank, handler in self._shm_handlers.items():
+            futures.append(self._executor.submit(
+                self._save_shard, handler, step, sdir, local_rank))
+        ok = all(f.result() for f in futures)
+        if ok:
+            self.commit_checkpoint(step, path)
+            self._last_persisted_step = step
+            self._latest_path = path
+            logger.info("persisted checkpoint step=%d to %s in %.2fs", step,
+                        sdir, time.time() - start)
+        else:
+            logger.error("failed to persist checkpoint step=%d", step)
+
+    def _save_shard(self, handler: SharedMemoryHandler, step: int,
+                    sdir: str, local_rank: int) -> bool:
+        """Parity: reference `_save_shard` :544 — stream one shm segment."""
+        header = handler.load_header()
+        if header is None:
+            logger.warning("no shm data for local rank %d", local_rank)
+            return False
+        if header.get("step") != step:
+            logger.warning("shm holds step %s, expected %s",
+                           header.get("step"), step)
+            return False
+        global_rank = self._global_rank(local_rank)
+        meta_path = os.path.join(sdir, f"meta_rank{global_rank}.json")
+        bin_path = os.path.join(sdir, f"shards_rank{global_rank}.bin")
+        # stream raw shard bytes; record each tensor's offset in the bin file
+        metas_out: List[Dict] = []
+        tmp = f"{bin_path}.tmp.{os.getpid()}"
+        os.makedirs(os.path.dirname(bin_path), exist_ok=True)
+        offset = 0
+        with open(tmp, "wb") as f:
+            for meta, view in handler.iter_shards():
+                f.write(view)
+                d = meta.to_dict()
+                d["file_offset"] = offset
+                offset += meta.nbytes
+                metas_out.append(d)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, bin_path)
+        self.storage.write(json.dumps({
+            "step": step,
+            "extra": header.get("extra", {}),
+            "tensors": metas_out,
+        }), meta_path)
+        done = os.path.join(sdir, CheckpointConstant.DONE_DIR,
+                            f"rank{global_rank}.done")
+        self.storage.write(str(step), done)
+        return True
+
+    def _global_rank(self, local_rank: int) -> int:
+        return self.node_rank * self.local_shard_num + local_rank
+
+    def commit_checkpoint(self, step: int, path: str,
+                          expected_shards: Optional[int] = None,
+                          timeout: float = CheckpointConstant.SAVE_TIMEOUT):
+        """Write the tracker file once all ranks' done-files exist.
+
+        Parity: reference `commit_checkpoint` :863 — rank-0 agent waits for
+        done files of every shard then atomically publishes the step.
+        """
+        if self.node_rank != 0:
+            return
+        sdir = step_dir(path, step)
+        done_dir = os.path.join(sdir, CheckpointConstant.DONE_DIR)
+        expected = expected_shards or self.local_shard_num
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if len(self.storage.listdir(done_dir)) >= expected:
+                tracker = os.path.join(path,
+                                       CheckpointConstant.TRACKER_FILE)
+                self.storage.write(str(step), tracker)
+                self.storage.commit(step, True)
+                return
+            time.sleep(0.2)
+        logger.error("commit timeout for step %d (%d/%d done)", step,
+                     len(self.storage.listdir(done_dir)), expected)
+
+    # ------------------------------------------------------- failure handling
+
+    def save_shm_to_storage(self, timeout: float = 120.0):
+        """Persist whatever is staged in shm — called on worker failure.
+
+        Parity: reference `save_shm_to_storage` :634.
+        """
+        steps = set()
+        for handler in self._shm_handlers.values():
+            header = handler.load_header()
+            if header is not None:
+                steps.add(header.get("step"))
+        if not steps:
+            return
+        step = max(s for s in steps if s is not None)
+        if step <= self._last_persisted_step or not self._latest_path:
+            return
+        logger.info("failure-save of staged step %d", step)
+        self.save_step_checkpoint(step, self._latest_path)
+
+    def register_path(self, path: str):
+        self._latest_path = path
+
+
+# -------------------------------------------------------------------- restore
+
+
+def read_last_step(path: str,
+                   storage: Optional[CheckpointStorage] = None) -> int:
+    storage = storage or get_checkpoint_storage()
+    content = storage.read(
+        os.path.join(path, CheckpointConstant.TRACKER_FILE), "r")
+    if not content:
+        return -1
+    try:
+        return int(str(content).strip())
+    except ValueError:
+        return -1
+
+
+def load_step_metas(path: str, step: int,
+                    storage: Optional[CheckpointStorage] = None) -> Dict[int, Dict]:
+    """Read every rank's meta json for a committed step."""
+    storage = storage or get_checkpoint_storage()
+    sdir = step_dir(path, step)
+    out = {}
+    for fname in storage.listdir(sdir):
+        if fname.startswith("meta_rank") and fname.endswith(".json"):
+            rank = int(fname[len("meta_rank"):-len(".json")])
+            content = storage.read(os.path.join(sdir, fname), "r")
+            if content:
+                out[rank] = json.loads(content)
+    return out

@@ -1,0 +1,197 @@
+"""Checkpoint engine: training-process side of flash checkpointing.
+
+Parity: reference `trainer/torch/flash_checkpoint/engine.py` (CheckpointEngine
+ABC :136, `save_state_dict_to_memory` :297, `save_to_storage` :409) and
+`full_ckpt_engine.py`.
+
+The engine runs inside each training process.  `save_to_memory` stages the
+sharded pytree into this process's shm segment (sub-second, blocks training);
+`save_to_storage` additionally enqueues an event for the agent-side
+`AsyncCheckpointSaver`, which persists shm → storage off the training path.
+In standalone mode (no agent) the engine hosts the saver daemon in-process.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..common.constants import CheckpointConstant
+from ..common.log import get_logger
+from ..common.multi_process import SharedQueue
+from ..common.storage import CheckpointStorage, get_checkpoint_storage
+from .ckpt_saver import (
+    AsyncCheckpointSaver,
+    CheckpointEvent,
+    load_step_metas,
+    read_last_step,
+    step_dir,
+)
+from .shm_handler import SharedMemoryHandler, _np_dtype, flatten_state_dict
+
+logger = get_logger("ckpt_engine")
+
+
+class CheckpointEngine:
+    def __init__(self, checkpoint_dir: str, local_rank: int = 0,
+                 job_name: str = "dwt", standalone: Optional[bool] = None,
+                 storage: Optional[CheckpointStorage] = None,
+                 local_shard_num: int = 1, node_rank: int = 0):
+        self.checkpoint_dir = checkpoint_dir
+        self.local_rank = local_rank
+        self.job_name = job_name
+        self.storage = storage or get_checkpoint_storage()
+        self._shm_handler = SharedMemoryHandler(local_rank, job_name)
+        self._saver: Optional[AsyncCheckpointSaver] = None
+        self._event_queue: Optional[SharedQueue] = None
+        self._latest_step = -1
+        if standalone is None:
+            standalone = AsyncCheckpointSaver.get_ckpt_saver() is None and \
+                node_rank == 0 and local_rank == 0
+        if standalone:
+            # host the async saver in-process (no separate agent)
+            self._saver = AsyncCheckpointSaver.start_async_saving_ckpt(
+                job_name, local_shard_num=local_shard_num,
+                node_rank=node_rank, storage=self.storage)
+            self._saver.register_path(checkpoint_dir)
+            self._event_queue = self._saver._event_queue
+        else:
+            self._event_queue = SharedQueue(f"{job_name}-ckpt-events",
+                                            master=False)
+
+    # ------------------------------------------------------------------ save
+
+    def save_to_memory(self, step: int, state: Any,
+                       extra_meta: Optional[Dict] = None) -> float:
+        """Stage pytree into shm; returns blocking time in seconds."""
+        t0 = time.time()
+        self._shm_handler.save_state_dict(state, step, extra_meta)
+        self._latest_step = step
+        return time.time() - t0
+
+    def save_to_storage(self, step: int, state: Any,
+                        path: Optional[str] = None,
+                        extra_meta: Optional[Dict] = None) -> float:
+        """Stage + hand off to the async saver. Returns blocking seconds."""
+        blocked = self.save_to_memory(step, state, extra_meta)
+        path = path or self.checkpoint_dir
+        if self._saver is not None:
+            self._saver.register_path(path)
+        self._event_queue.put(CheckpointEvent.save(step, path))
+        return blocked
+
+    def wait_saving_latest(self, timeout: float = 600.0) -> bool:
+        """Block until the latest staged step is committed (for tests/exit)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if read_last_step(self.checkpoint_dir,
+                              self.storage) >= self._latest_step:
+                return True
+            time.sleep(0.1)
+        return False
+
+    # ------------------------------------------------------------------ load
+
+    def load(self, path: Optional[str] = None,
+             step: Optional[int] = None) -> Optional[Dict[str, np.ndarray]]:
+        """Load flat {name: np.ndarray} — from shm if fresh, else storage.
+
+        Names containing ``#shardN`` are assembled into full global arrays.
+        """
+        shm = self._shm_handler.load_state_dict()
+        if shm is not None and (step is None or shm[0] == step):
+            shm_step, flat, metas, _ = shm
+            if step is not None or shm_step >= read_last_step(
+                    path or self.checkpoint_dir, self.storage):
+                return self._assemble(
+                    [dict(m.to_dict(), array=flat[m.name]) for m in metas])
+        return self.load_from_storage(path, step)
+
+    def load_from_storage(self, path: Optional[str] = None,
+                          step: Optional[int] = None
+                          ) -> Optional[Dict[str, np.ndarray]]:
+        path = path or self.checkpoint_dir
+        if step is None:
+            step = read_last_step(path, self.storage)
+        if step < 0:
+            return None
+        rank_metas = load_step_metas(path, step, self.storage)
+        if not rank_metas:
+            return None
+        entries = []
+        for rank, meta in rank_metas.items():
+            sdir = step_dir(path, step)
+            bin_path = os.path.join(sdir, f"shards_rank{rank}.bin")
+            raw = self.storage.read(bin_path)
+            if raw is None:
+                logger.error("missing shard file %s", bin_path)
+                return None
+            for t in meta["tensors"]:
+                arr = np.frombuffer(
+                    raw, dtype=_np_dtype(t["dtype"]),
+                    count=int(np.prod(t["shape"])) if t["shape"] else 1,
+                    offset=t["file_offset"]).reshape(t["shape"])
+                entries.append(dict(t, array=arr))
+        return self._assemble(entries)
+
+    @staticmethod
+    def _assemble(entries) -> Dict[str, np.ndarray]:
+        """Merge `name#shardN` pieces into global arrays by their indices."""
+        out: Dict[str, np.ndarray] = {}
+        partial: Dict[str, np.ndarray] = {}
+        for e in entries:
+            name = e["name"]
+            base = name.split("#shard")[0]
+            if "#shard" not in name:
+                out[base] = e["array"]
+                continue
+            if base not in partial:
+                partial[base] = np.empty(e["global_shape"],
+                                         dtype=e["array"].dtype)
+            slices = tuple(slice(s, t) for s, t in e["index"])
+            partial[base][slices] = e["array"]
+        out.update(partial)
+        return out
+
+    def latest_step(self) -> int:
+        return max(self._latest_step,
+                   read_last_step(self.checkpoint_dir, self.storage))
+
+    def close(self):
+        self._shm_handler.close()
+        if self._event_queue is not None and self._saver is None:
+            self._event_queue.close()
+
+
+def restore_pytree(template: Any, flat: Dict[str, np.ndarray]) -> Any:
+    """Rebuild a pytree (matching `template`'s structure/shardings) from the
+    flat name→array dict returned by `CheckpointEngine.load`.
+
+    Leaves of `template` that are `jax.Array`s (or ShapeDtypeStruct with a
+    .sharding) get `jax.device_put(value, sharding)` so each process only
+    materializes its addressable shards.
+    """
+    import jax
+
+    flat_template = flatten_state_dict(template)
+    leaves_by_name = {}
+    for name, leaf in flat_template.items():
+        if name not in flat:
+            raise KeyError(f"checkpoint missing tensor {name!r}")
+        value = flat[name]
+        sharding = getattr(leaf, "sharding", None)
+        dtype = getattr(leaf, "dtype", None)
+        if dtype is not None and value.dtype != dtype:
+            value = value.astype(dtype)
+        if sharding is not None:
+            leaves_by_name[name] = jax.device_put(value, sharding)
+        else:
+            leaves_by_name[name] = value
+    # rebuild in template order
+    treedef = jax.tree_util.tree_structure(template)
+    ordered = [leaves_by_name[name] for name in flat_template]
+    return jax.tree_util.tree_unflatten(treedef, ordered)

@@ -1,0 +1,252 @@
+"""Shared-memory staging of sharded `jax.Array` pytrees.
+
+Parity: reference `elastic_agent/torch/ckpt_saver.py:65-341` (`TensorMeta`,
+`SharedMemoryHandler.save_state_dict`, `_write_shared_memory`) — pickle-free
+tensor staging in POSIX shm so the agent process can persist checkpoints
+asynchronously while training continues.
+
+TPU redesign: a checkpoint is a pytree of `jax.Array`s that may be sharded over
+the global device mesh.  Each training process stages the *addressable* shards
+of every leaf (device→host DMA + one memcpy into shm).  Restore rebuilds either
+numpy leaves (local/global) or `jax.Array`s via
+`jax.make_array_from_single_device_arrays` when a sharding is supplied.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..common.log import get_logger
+from ..common.multi_process import SharedMemoryBuffer
+
+logger = get_logger("shm_handler")
+
+try:  # bfloat16/f8 numpy dtypes
+    import ml_dtypes  # noqa: F401
+
+    _EXTRA_DTYPES = {
+        "bfloat16": np.dtype(ml_dtypes.bfloat16),
+        "float8_e4m3fn": np.dtype(ml_dtypes.float8_e4m3fn),
+        "float8_e5m2": np.dtype(ml_dtypes.float8_e5m2),
+    }
+except ImportError:  # pragma: no cover
+    _EXTRA_DTYPES = {}
+
+_HEADER_SIZE = 1 << 20  # fixed 1MB JSON header region
+
+
+def _np_dtype(name: str) -> np.dtype:
+    if name in _EXTRA_DTYPES:
+        return _EXTRA_DTYPES[name]
+    return np.dtype(name)
+
+
+@dataclass
+class TensorMeta:
+    """Location of one array shard inside the shm segment."""
+
+    name: str
+    dtype: str
+    shape: List[int]  # shard (local) shape
+    offset: int
+    nbytes: int
+    global_shape: List[int] = field(default_factory=list)
+    # per-dim [start, stop) of this shard within the global array
+    index: List[List[int]] = field(default_factory=list)
+
+    def to_dict(self):
+        return {
+            "name": self.name, "dtype": self.dtype, "shape": self.shape,
+            "offset": self.offset, "nbytes": self.nbytes,
+            "global_shape": self.global_shape, "index": self.index,
+        }
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(**d)
+
+
+def _leaf_entries(name: str, value: Any) -> List[Tuple[str, np.ndarray,
+                                                       List[int],
+                                                       List[List[int]]]]:
+    """Expand one pytree leaf into (name, host_array, global_shape, index)."""
+    entries = []
+    if hasattr(value, "addressable_shards"):  # jax.Array
+        global_shape = list(value.shape)
+        unique: Dict[tuple, np.ndarray] = {}
+        for shard in value.addressable_shards:
+            idx = []
+            for dim, sl in enumerate(shard.index):
+                start = sl.start if sl.start is not None else 0
+                stop = sl.stop if sl.stop is not None else global_shape[dim]
+                idx.append((start, stop))
+            key = tuple(idx)
+            if key not in unique:  # skip replicas of the same slice
+                unique[key] = np.asarray(shard.data)
+        whole = len(unique) == 1 and next(iter(unique)) == tuple(
+            (0, s) for s in global_shape)
+        for i, (key, host) in enumerate(unique.items()):
+            ename = name if whole else f"{name}#shard{i}"
+            entries.append((ename, host, global_shape,
+                            [list(se) for se in key]))
+    else:
+        host = np.asarray(value)
+        entries.append((name, host, list(host.shape),
+                        [[0, s] for s in host.shape]))
+    return entries
+
+
+def flatten_state_dict(state: Any) -> Dict[str, Any]:
+    """Pytree → flat {path: leaf} with '/'-joined string paths."""
+    import jax
+
+    flat = {}
+    leaves = jax.tree_util.tree_flatten_with_path(state)[0]
+    for path, leaf in leaves:
+        parts = []
+        for p in path:
+            if hasattr(p, "key"):
+                parts.append(str(p.key))
+            elif hasattr(p, "idx"):
+                parts.append(str(p.idx))
+            elif hasattr(p, "name"):
+                parts.append(str(p.name))
+            else:
+                parts.append(str(p))
+        flat["/".join(parts) if parts else "leaf"] = leaf
+    return flat
+
+
+class SharedMemoryHandler:
+    """Owns one shm segment staging one process's checkpoint shards."""
+
+    def __init__(self, local_rank: int, job_name: str = "dwt",
+                 create: bool = False):
+        self._name = f"{job_name}_ckpt_shm_{local_rank}"
+        self.local_rank = local_rank
+        self._buf: Optional[SharedMemoryBuffer] = None
+        self._lock = threading.Lock()
+
+    @property
+    def shm_name(self) -> str:
+        return self._name
+
+    def _ensure_size(self, needed: int):
+        if self._buf is None or self._buf.size < needed:
+            if self._buf is not None:
+                self._buf.close()
+            size = 1 << max(20, math.ceil(math.log2(needed)))
+            self._buf = SharedMemoryBuffer(self._name, create=True, size=size)
+
+    def attach(self) -> bool:
+        try:
+            if self._buf is None:
+                self._buf = SharedMemoryBuffer(self._name)
+            return True
+        except FileNotFoundError:
+            return False
+
+    def enough_space(self, state: Any) -> bool:
+        return True  # segment grows on demand
+
+    # ----------------------------------------------------------------- write
+
+    def save_state_dict(self, state: Any, step: int = 0,
+                        extra_meta: Optional[Dict] = None):
+        """Stage a pytree of arrays into shm (blocking part of a flash save)."""
+        flat = flatten_state_dict(state)
+        metas: List[TensorMeta] = []
+        payloads: List[np.ndarray] = []
+        offset = _HEADER_SIZE
+        for name, leaf in flat.items():
+            for ename, host, gshape, index in _leaf_entries(name, leaf):
+                host = np.ascontiguousarray(host)
+                metas.append(TensorMeta(
+                    name=ename, dtype=host.dtype.name,
+                    shape=list(host.shape), offset=offset,
+                    nbytes=host.nbytes, global_shape=gshape, index=index))
+                payloads.append(host)
+                offset += host.nbytes
+        header = {
+            "step": step,
+            "metas": [m.to_dict() for m in metas],
+            "extra": extra_meta or {},
+        }
+        header_bytes = json.dumps(header).encode()
+        if len(header_bytes) + 8 > _HEADER_SIZE:
+            raise ValueError("checkpoint meta header exceeds 1MB")
+        with self._lock:
+            self._ensure_size(offset)
+            buf = self._buf.buf
+            buf[0:8] = len(header_bytes).to_bytes(8, "big")
+            buf[8:8 + len(header_bytes)] = header_bytes
+            for meta, host in zip(metas, payloads):
+                view = host.view(np.uint8).reshape(-1)
+                buf[meta.offset:meta.offset + meta.nbytes] = view
+
+    # ------------------------------------------------------------------ read
+
+    def load_header(self) -> Optional[Dict]:
+        if not self.attach():
+            return None
+        buf = self._buf.buf
+        n = int.from_bytes(bytes(buf[0:8]), "big")
+        if n == 0 or n > _HEADER_SIZE - 8:
+            return None
+        try:
+            return json.loads(bytes(buf[8:8 + n]).decode())
+        except ValueError:
+            return None
+
+    def load_state_dict(self) -> Optional[Tuple[int, Dict[str, np.ndarray],
+                                                List[TensorMeta], Dict]]:
+        """Returns (step, {name: np.ndarray}, metas, extra) or None."""
+        header = self.load_header()
+        if header is None:
+            return None
+        buf = self._buf.buf
+        out: Dict[str, np.ndarray] = {}
+        metas = [TensorMeta.from_dict(m) for m in header["metas"]]
+        for meta in metas:
+            raw = np.frombuffer(
+                bytes(buf[meta.offset:meta.offset + meta.nbytes]),
+                dtype=_np_dtype(meta.dtype))
+            out[meta.name] = raw.reshape(meta.shape)
+        return header.get("step", 0), out, metas, header.get("extra", {})
+
+    def iter_shards(self):
+        """Yield (meta, memoryview) without copying — for the async saver."""
+        header = self.load_header()
+        if header is None:
+            return
+        buf = self._buf.buf
+        for m in header["metas"]:
+            meta = TensorMeta.from_dict(m)
+            yield meta, buf[meta.offset:meta.offset + meta.nbytes]
+
+    def mark_empty(self):
+        if self._buf is not None:
+            self._buf.buf[0:8] = (0).to_bytes(8, "big")
+
+    def close(self):
+        with self._lock:
+            if self._buf is not None:
+                self._buf.close()
+                self._buf = None
+
+    def unlink(self):
+        with self._lock:
+            if self._buf is None:
+                try:
+                    self._buf = SharedMemoryBuffer(self._name)
+                except FileNotFoundError:
+                    return
+            self._buf.unlink()
+            self._buf.close()
+            self._buf = None

@@ -6,10 +6,16 @@ on a single host — here with XLA's forced host-platform device count.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # force off TPU even if axon is set
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("DWT_SOCKET_DIR", "/tmp/dwt-test/sockets")
+
+# The axon sitecustomize sets jax_platforms="axon,cpu" via jax.config at
+# interpreter start (config beats env); force it back to CPU for tests.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
