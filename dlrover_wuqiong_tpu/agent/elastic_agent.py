@@ -1,0 +1,307 @@
+"""Elastic training agent: node-level supervisor of JAX worker processes.
+
+Parity: reference `dlrover/python/elastic_agent/torch/training.py`
+(`ElasticTrainingAgent` :362, `_invoke_run` :580, `_assign_worker_ranks` :484,
+`_restart_workers` :704, `launch_agent` :734, `MasterRendezvousHandler` :179).
+
+TPU redesign: instead of torch-elastic WorkerSpecs + NCCL process groups, the
+agent forms a `jax.distributed` world from the master rendezvous — rank-0's
+ip:port becomes the coordinator — then launches ONE worker process per host
+(the JAX/TPU model: a process owns all local chips) with the world contract in
+env vars.  Elasticity is restart-the-world: on failure or membership change the
+agent persists the staged flash checkpoint, kills workers, re-joins rendezvous
+and relaunches with the new world (goodput comes from detection + restore
+speed, SURVEY.md §7 hard-part (a)).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..checkpoint.ckpt_saver import AsyncCheckpointSaver
+from ..common.comm import find_free_port
+from ..common.constants import JobConstant, NodeEnv, RendezvousName
+from ..common.log import get_logger
+from .master_client import MasterClient
+
+logger = get_logger("elastic_agent")
+
+
+@dataclass
+class ElasticLaunchConfig:
+    """Parity: reference ElasticLaunchConfig (training.py:117) +
+    auto_configure_params (:153)."""
+
+    min_nodes: int = 1
+    max_nodes: int = 1
+    nproc_per_node: int = 1
+    max_restarts: int = 3
+    network_check: bool = False
+    node_unit: int = 1
+    rdzv_timeout: float = 600.0
+    monitor_interval: float = 1.0
+    log_dir: str = ""
+
+    def auto_configure_params(self):
+        self.network_check = self.network_check or (
+            os.getenv("DWT_NETWORK_CHECK", "") == "1")
+        if self.max_nodes >= 4 and os.getenv(
+                "DWT_NETWORK_CHECK", "auto") == "auto":
+            self.network_check = True
+
+
+class WorkerContext:
+    """One launched training process + its world assignment."""
+
+    def __init__(self, proc: subprocess.Popen, process_id: int,
+                 num_processes: int, restart_count: int):
+        self.proc = proc
+        self.process_id = process_id
+        self.num_processes = num_processes
+        self.restart_count = restart_count
+
+
+class RendezvousOutcome:
+    def __init__(self, rdzv_round: int, process_id: int, num_processes: int,
+                 coordinator_addr: str, local_world_size: int):
+        self.rdzv_round = rdzv_round
+        self.process_id = process_id
+        self.num_processes = num_processes
+        self.coordinator_addr = coordinator_addr
+        self.local_world_size = local_world_size
+
+
+class ElasticAgent:
+    def __init__(self, config: ElasticLaunchConfig, master_client: MasterClient,
+                 node_id: int, node_rank: int,
+                 entrypoint: Optional[List[str]] = None,
+                 worker_env: Optional[Dict[str, str]] = None):
+        self.config = config
+        self.mc = master_client
+        self.node_id = node_id
+        self.node_rank = node_rank
+        self.entrypoint = entrypoint or []
+        self.worker_env = worker_env or {}
+        self._worker: Optional[WorkerContext] = None
+        self._restart_count = 0
+        self._stopped = threading.Event()
+        self._saver: Optional[AsyncCheckpointSaver] = None
+        self._heartbeat_thread: Optional[threading.Thread] = None
+        self._last_restart_ts = 0.0
+
+    # ------------------------------------------------------------- rendezvous
+
+    def rendezvous(self,
+                   name: str = RendezvousName.ELASTIC_TRAINING
+                   ) -> RendezvousOutcome:
+        """Join + poll until the master forms the world.
+
+        Parity: reference MasterRendezvousHandler.next_rendezvous (:250).
+        """
+        free_port = find_free_port()
+        self.mc.join_rendezvous(
+            self.node_rank, self.config.nproc_per_node, rdzv_name=name,
+            node_ip=os.getenv("DWT_NODE_IP", "127.0.0.1"),
+            free_port=free_port)
+        deadline = time.time() + self.config.rdzv_timeout
+        while time.time() < deadline:
+            state = self.mc.get_comm_world(rdzv_name=name)
+            if state.complete:
+                my_rank = None
+                total_procs = 0
+                ranks = sorted(int(r) for r in state.world)
+                for rank in ranks:
+                    nid, lws, ip, port = state.world[str(rank)]
+                    if nid == self.node_id:
+                        my_rank = rank
+                    total_procs += 1
+                if my_rank is None:
+                    # we were not included (e.g. over max_nodes) — rejoin
+                    time.sleep(1.0)
+                    self.mc.join_rendezvous(
+                        self.node_rank, self.config.nproc_per_node,
+                        rdzv_name=name,
+                        node_ip=os.getenv("DWT_NODE_IP", "127.0.0.1"),
+                        free_port=free_port)
+                    continue
+                return RendezvousOutcome(
+                    state.rdzv_round, my_rank, total_procs,
+                    state.coordinator_addr, self.config.nproc_per_node)
+            time.sleep(0.5)
+        raise TimeoutError(f"rendezvous {name} did not complete")
+
+    # ------------------------------------------------------------- lifecycle
+
+    def _start_saver(self):
+        if self._saver is None:
+            self._saver = AsyncCheckpointSaver.start_async_saving_ckpt(
+                job_name=os.getenv(NodeEnv.JOB_NAME, "dwt"),
+                local_shard_num=1, node_rank=self.node_rank)
+
+    def _launch_worker(self, outcome: RendezvousOutcome) -> WorkerContext:
+        env = dict(os.environ)
+        env.update(self.worker_env)
+        # make this framework importable in the worker regardless of its cwd
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        pythonpath = env.get("PYTHONPATH", "")
+        if pkg_root not in pythonpath.split(os.pathsep):
+            env["PYTHONPATH"] = (f"{pkg_root}{os.pathsep}{pythonpath}"
+                                 if pythonpath else pkg_root)
+        env.update({
+            NodeEnv.MASTER_ADDR: self.mc.master_addr,
+            NodeEnv.NODE_ID: str(self.node_id),
+            NodeEnv.NODE_RANK: str(self.node_rank),
+            NodeEnv.COORDINATOR_ADDR: outcome.coordinator_addr,
+            NodeEnv.PROCESS_ID: str(outcome.process_id),
+            NodeEnv.NUM_PROCESSES: str(outcome.num_processes),
+            NodeEnv.LOCAL_DEVICE_COUNT: str(outcome.local_world_size),
+            NodeEnv.RESTART_COUNT: str(self._restart_count),
+        })
+        stdout = None
+        if self.config.log_dir:
+            os.makedirs(self.config.log_dir, exist_ok=True)
+            stdout = open(os.path.join(
+                self.config.log_dir,
+                f"worker_{self.node_rank}_r{self._restart_count}.log"), "ab")
+        proc = subprocess.Popen(
+            self.entrypoint, env=env, stdout=stdout,
+            stderr=subprocess.STDOUT if stdout else None,
+            start_new_session=True)
+        logger.info("launched worker pid=%d process_id=%d/%d coord=%s",
+                    proc.pid, outcome.process_id, outcome.num_processes,
+                    outcome.coordinator_addr)
+        return WorkerContext(proc, outcome.process_id,
+                             outcome.num_processes, self._restart_count)
+
+    def _stop_worker(self, timeout: float = 30.0):
+        if self._worker is None:
+            return
+        proc = self._worker.proc
+        if proc.poll() is None:
+            try:
+                os.killpg(proc.pid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+            try:
+                proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+                proc.wait(timeout=10)
+        self._worker = None
+
+    def _start_heartbeat(self):
+        def _loop():
+            while not self._stopped.wait(JobConstant.HEARTBEAT_INTERVAL_SECS):
+                try:
+                    action = self.mc.report_heart_beat()
+                    if action == "restart" and self._worker is not None:
+                        logger.info("master requested worker restart")
+                        self._stop_worker()
+                except Exception:  # noqa: BLE001
+                    logger.warning("heartbeat failed", exc_info=True)
+
+        self._heartbeat_thread = threading.Thread(
+            target=_loop, daemon=True, name="dwt-agent-heartbeat")
+        self._heartbeat_thread.start()
+
+    # --------------------------------------------------------------- run loop
+
+    def run(self) -> int:
+        """Supervisor loop. Parity: reference `_invoke_run` (:580)."""
+        self._start_saver()
+        self._start_heartbeat()
+        self.mc.register_node(self.node_rank,
+                              accelerator_num=self.config.nproc_per_node)
+        while not self._stopped.is_set():
+            outcome = self.rendezvous()
+            self._worker = self._launch_worker(outcome)
+            exit_code = self._monitor_worker()
+            if exit_code == 0:
+                logger.info("worker succeeded")
+                return 0
+            if exit_code is None:
+                # membership change → restart workers into a new world
+                logger.info("membership change — restarting worker")
+                self._stop_worker()
+                continue
+            # failure path
+            logger.warning("worker failed with exit code %s", exit_code)
+            if self._saver is not None:
+                try:
+                    self._saver.save_shm_to_storage()
+                except Exception:  # noqa: BLE001
+                    logger.exception("failure-save failed")
+            self.mc.report_failure(f"exit_code={exit_code}",
+                                   restart_count=self._restart_count)
+            self._restart_count += 1
+            if self._restart_count > self.config.max_restarts:
+                logger.error("max restarts (%d) exhausted",
+                             self.config.max_restarts)
+                return exit_code
+            self._stop_worker()
+        return 1
+
+    def _monitor_worker(self) -> Optional[int]:
+        """Wait for worker exit or membership change.
+
+        Returns exit code, or None when a re-rendezvous is needed.
+        """
+        proc = self._worker.proc
+        while not self._stopped.is_set():
+            code = proc.poll()
+            if code is not None:
+                return code
+            if self._membership_changed():
+                return None
+            time.sleep(self.config.monitor_interval)
+        return proc.poll() if proc.poll() is not None else 1
+
+    def _membership_changed(self) -> bool:
+        """Parity: reference `_membership_changed` :711 (debounced)."""
+        now = time.time()
+        if now - self._last_restart_ts < JobConstant.RESTART_DEBOUNCE_SECS:
+            return False
+        try:
+            waiting = self.mc.num_nodes_waiting()
+        except Exception:  # noqa: BLE001
+            return False
+        if waiting > 0:
+            self._last_restart_ts = now
+            return True
+        return False
+
+    def stop(self):
+        self._stopped.set()
+        self._stop_worker()
+        if self._saver is not None:
+            AsyncCheckpointSaver.reset()
+            self._saver = None
+
+
+def launch_agent(config: ElasticLaunchConfig, entrypoint: List[str],
+                 master_addr: str, node_id: int, node_rank: int) -> int:
+    """Parity: reference launch_agent (training.py:734)."""
+    config.auto_configure_params()
+    mc = MasterClient(master_addr, node_id)
+    agent = ElasticAgent(config, mc, node_id, node_rank, entrypoint)
+    if config.network_check:
+        from .node_check import run_network_check
+        ok = run_network_check(agent)
+        if not ok:
+            logger.error("node failed network check")
+            return 3
+    try:
+        return agent.run()
+    finally:
+        agent.stop()

@@ -1,0 +1,91 @@
+"""Agent-side resource monitor: host cpu/mem (+ TPU runtime metrics) → master.
+
+Parity: reference `elastic_agent/monitor/resource.py` (ResourceMonitor :86,
+report_resource :157; psutil+pynvml there, psutil+libtpu-metrics here) and
+`monitor/training.py` (TrainingProcessReporter).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+from ..common.log import get_logger
+from .master_client import MasterClient
+
+logger = get_logger("monitor")
+
+
+def get_process_resource() -> Dict[str, float]:
+    """Host usage of this process tree (no psutil dependency required)."""
+    stats: Dict[str, float] = {"cpu_percent": 0.0, "memory_mb": 0.0}
+    try:
+        import psutil
+
+        proc = psutil.Process()
+        stats["cpu_percent"] = proc.cpu_percent(interval=None)
+        stats["memory_mb"] = proc.memory_info().rss / (1 << 20)
+    except ImportError:
+        try:
+            import resource
+
+            usage = resource.getrusage(resource.RUSAGE_SELF)
+            stats["memory_mb"] = usage.ru_maxrss / 1024.0
+        except Exception:  # noqa: BLE001
+            pass
+    return stats
+
+
+def get_accelerator_stats() -> Dict[str, float]:
+    """TPU-side stats via jax (device memory where the backend exposes it)."""
+    stats: Dict[str, float] = {}
+    try:
+        import jax
+
+        devs = jax.local_devices()
+        stats["num_devices"] = float(len(devs))
+        for d in devs[:1]:
+            mem = getattr(d, "memory_stats", None)
+            if callable(mem):
+                m = mem() or {}
+                stats["hbm_bytes_in_use"] = float(
+                    m.get("bytes_in_use", 0))
+                stats["hbm_bytes_limit"] = float(
+                    m.get("bytes_limit", 0))
+    except Exception:  # noqa: BLE001
+        pass
+    return stats
+
+
+class ResourceMonitor:
+    def __init__(self, master_client: MasterClient,
+                 interval: float = 30.0):
+        self.mc = master_client
+        self.interval = interval
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="dwt-resource-monitor")
+        self._thread.start()
+
+    def _loop(self):
+        from ..common import messages as msg
+
+        while not self._stopped.wait(self.interval):
+            try:
+                host = get_process_resource()
+                accel = get_accelerator_stats()
+                self.mc._client.report(msg.ResourceStats(
+                    node_id=self.mc.node_id,
+                    cpu_percent=host["cpu_percent"],
+                    memory_mb=host["memory_mb"],
+                    accelerator_stats=accel))
+            except Exception:  # noqa: BLE001
+                logger.debug("resource report failed", exc_info=True)
+
+    def stop(self):
+        self._stopped.set()

@@ -1,0 +1,122 @@
+"""TPU node health-check workload: matmul + collective benchmark.
+
+Parity: reference `dlrover/trainer/torch/node_check/nvidia_gpu.py` (matmul
+`utils.py:269`, `bm_allgather` :178) + `NodeCheckElasticAgent`
+(training.py:864-1092).  GPU XID checks become TPU chip probes: a large bf16
+matmul exercises the MXU; an all-gather over the local mesh (and, cross-host,
+over ICI/DCN via jax.distributed) exercises the interconnect.  Results are
+reported to the master's NetworkCheckRendezvousManager, which runs the 2-round
+pairwise sweep to isolate the faulty node and flag stragglers.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional, Tuple
+
+from ..common.constants import RendezvousName
+from ..common.log import get_logger
+
+logger = get_logger("node_check")
+
+
+def matmul_benchmark(size: int = 2048, rounds: int = 8) -> float:
+    """Time a chain of bf16 matmuls on the local accelerator (MXU probe)."""
+    import jax
+    import jax.numpy as jnp
+
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (size, size), dtype=jnp.bfloat16)
+
+    @jax.jit
+    def chain(x):
+        def body(carry, _):
+            y = carry @ carry
+            # renormalize so values stay finite
+            y = y / (jnp.sqrt(jnp.float32(size)).astype(jnp.bfloat16))
+            return y, ()
+        out, _ = jax.lax.scan(body, x, None, length=rounds)
+        return out
+
+    chain(x).block_until_ready()  # warmup/compile
+    t0 = time.time()
+    chain(x).block_until_ready()
+    return time.time() - t0
+
+
+def allgather_benchmark(nbytes: int = 1 << 24) -> float:
+    """Time an all-gather across all visible devices (ICI probe)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devices = jax.devices()
+    n = len(devices)
+    if n == 1:
+        # single chip: time a HBM round-trip instead
+        x = jnp.ones((nbytes // 4,), jnp.float32)
+        y = jax.device_put(x)
+        t0 = time.time()
+        jax.device_get(y)
+        return time.time() - t0
+    mesh = Mesh(np.array(devices), ("x",))
+    per = nbytes // 4 // n * n
+    x = jax.device_put(
+        jnp.ones((per,), jnp.float32),
+        NamedSharding(mesh, P("x")))
+
+    @jax.jit
+    def gather(x):
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(None)))
+
+    gather(x).block_until_ready()
+    t0 = time.time()
+    gather(x).block_until_ready()
+    return time.time() - t0
+
+
+def run_check_workload(matmul_size: int = 2048) -> Tuple[bool, float]:
+    """Returns (healthy, elapsed_seconds)."""
+    if os.getenv("DWT_MOCK_NODE_CHECK_FAIL") == "1":
+        # fault-injection hook (parity: node_check/utils.py:169 mock_error)
+        return False, 0.0
+    try:
+        t_matmul = matmul_benchmark(matmul_size)
+        t_comm = allgather_benchmark()
+        elapsed = t_matmul + t_comm
+        logger.info("node check ok: matmul=%.3fs comm=%.3fs", t_matmul,
+                    t_comm)
+        return True, elapsed
+    except Exception:  # noqa: BLE001 — any chip/runtime error = unhealthy
+        logger.exception("node check workload failed")
+        return False, 0.0
+
+
+def run_network_check(agent, rounds: int = 2,
+                      timeout: float = 300.0) -> bool:
+    """Drive `rounds` sweeps of the pairwise check through the master.
+
+    Parity: reference NodeCheckElasticAgent.run (:905) + node_health_check
+    (:1073).
+    """
+    for r in range(rounds):
+        outcome = agent.rendezvous(name=RendezvousName.NETWORK_CHECK)
+        healthy, elapsed = run_check_workload()
+        agent.mc.report_network_check_result(healthy, elapsed)
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            success, reason = agent.mc.network_check_success()
+            if success:
+                break
+            if reason == "Node failure":
+                break
+            time.sleep(0.5)
+    success, _ = agent.mc.network_check_success()
+    if not success:
+        stragglers = agent.mc.get_stragglers()
+        if stragglers:
+            logger.warning("stragglers detected: %s", stragglers)
+    return success

@@ -50,8 +50,13 @@ class CheckpointEngine:
         self._event_queue: Optional[SharedQueue] = None
         self._latest_step = -1
         if standalone is None:
-            standalone = AsyncCheckpointSaver.get_ckpt_saver() is None and \
-                node_rank == 0 and local_rank == 0
+            # a worker launched by an elastic agent must attach to the agent's
+            # saver queue, never host its own (socket-name collision)
+            from ..common.constants import NodeEnv
+
+            attached = os.getenv(NodeEnv.MASTER_ADDR) is not None
+            standalone = (not attached
+                          and AsyncCheckpointSaver.get_ckpt_saver() is None)
         if standalone:
             # host the async saver in-process (no separate agent)
             self._saver = AsyncCheckpointSaver.start_async_saving_ckpt(

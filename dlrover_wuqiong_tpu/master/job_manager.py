@@ -240,3 +240,15 @@ class LocalJobManager(JobManager):
         for i in range(num_workers):
             node = self.register_node(NodeType.WORKER, i, rank_index=i)
             node.update_status(NodeStatus.PENDING)
+
+    def _relaunch_node(self, old_node: Node):
+        # local processes keep their identity across restarts: reset in place
+        with self._lock:
+            old_node.inc_relaunch_count()
+            old_node.status = NodeStatus.INITIAL
+            old_node.exit_reason = ""
+            old_node.heartbeat_time = time.time()
+        logger.info("local relaunch of %s (attempt %d)", old_node,
+                    old_node.relaunch_count)
+        for listener in self._relaunch_listeners:
+            listener(old_node, old_node)
