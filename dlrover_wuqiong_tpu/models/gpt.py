@@ -1,0 +1,160 @@
+"""GPT-2-family language model (nanoGPT-class), TPU-first.
+
+Parity: the reference trains nanoGPT/GPT-2 in its examples and benchmarks
+(`examples/pytorch/nanogpt`, BASELINE.md flash-ckpt rows use GPT-2 xl 1.5B).
+This is a native flax implementation: bf16 compute, flash-attention kernel for
+the hot op, `jax.checkpoint` rematerialization per block, parameter names
+aligned with `parallel/sharding.py` TRANSFORMER_RULES so TP/FSDP specs apply
+with no per-model glue.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.flash_attention import mha
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50304  # padded to multiple of 128 for the MXU
+    n_layer: int = 12
+    n_head: int = 12
+    n_embd: int = 768
+    block_size: int = 1024
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    use_flash_attention: bool = True
+
+    @classmethod
+    def nano(cls):  # tiny config for tests
+        return cls(vocab_size=512, n_layer=2, n_head=2, n_embd=128,
+                   block_size=128)
+
+    @classmethod
+    def gpt2(cls):
+        return cls(n_layer=12, n_head=12, n_embd=768)
+
+    @classmethod
+    def gpt2_medium(cls):
+        return cls(n_layer=24, n_head=16, n_embd=1024)
+
+    @classmethod
+    def gpt2_large(cls):
+        return cls(n_layer=36, n_head=20, n_embd=1280)
+
+    @classmethod
+    def gpt2_xl(cls):  # 1.5B — the flash-ckpt baseline model
+        return cls(n_layer=48, n_head=25, n_embd=1600)
+
+    @property
+    def head_dim(self) -> int:
+        return self.n_embd // self.n_head
+
+    def num_params(self) -> int:
+        wte = self.vocab_size * self.n_embd
+        wpe = self.block_size * self.n_embd
+        per_layer = 12 * self.n_embd * self.n_embd + 13 * self.n_embd
+        return wte + wpe + self.n_layer * per_layer + 2 * self.n_embd
+
+
+class CausalSelfAttention(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.config
+        B, T, C = x.shape
+        qkv = nn.Dense(3 * C, dtype=cfg.dtype, name="c_attn")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, cfg.n_head, cfg.head_dim)
+        k = k.reshape(B, T, cfg.n_head, cfg.head_dim)
+        v = v.reshape(B, T, cfg.n_head, cfg.head_dim)
+        if cfg.use_flash_attention:
+            y = mha(q, k, v, causal=True)
+        else:
+            att = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
+                jnp.float32(cfg.head_dim)).astype(cfg.dtype)
+            mask = jnp.tril(jnp.ones((T, T), bool))
+            att = jnp.where(mask, att, jnp.finfo(att.dtype).min)
+            att = jax.nn.softmax(att.astype(jnp.float32),
+                                 axis=-1).astype(cfg.dtype)
+            y = jnp.einsum("bhqk,bkhd->bqhd", att, v)
+        y = y.reshape(B, T, C)
+        y = nn.Dense(C, dtype=cfg.dtype, name="c_proj")(y)
+        if cfg.dropout > 0:
+            y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
+        return y
+
+
+class MLP(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.config
+        h = nn.Dense(4 * cfg.n_embd, dtype=cfg.dtype, name="c_fc")(x)
+        h = jax.nn.gelu(h)
+        h = nn.Dense(cfg.n_embd, dtype=cfg.dtype, name="c_proj")(h)
+        if cfg.dropout > 0:
+            h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+        return h
+
+
+class Block(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.config
+        x = x + CausalSelfAttention(cfg, name="attn")(
+            nn.LayerNorm(dtype=cfg.dtype, name="ln_1")(x), deterministic)
+        x = x + MLP(cfg, name="mlp")(
+            nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x), deterministic)
+        return x
+
+
+class GPT(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, idx, deterministic: bool = True):
+        cfg = self.config
+        B, T = idx.shape
+        tok = nn.Embed(cfg.vocab_size, cfg.n_embd,
+                       dtype=cfg.dtype, name="wte")(idx)
+        pos = nn.Embed(cfg.block_size, cfg.n_embd,
+                       dtype=cfg.dtype, name="wpe")(jnp.arange(T)[None, :])
+        x = tok + pos
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, prevent_cse=False)
+        for i in range(cfg.n_layer):
+            x = block(cfg, name=f"h_{i}")(x, deterministic)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        # weight-tied lm head (einsum against wte)
+        wte = self.variables["params"]["wte"]["embedding"]
+        logits = jnp.einsum("bte,ve->btv", x, wte.astype(cfg.dtype))
+        return logits
+
+    def init_params(self, rng, batch: int = 1, seq: int = 8):
+        idx = jnp.zeros((batch, seq), jnp.int32)
+        return self.init(rng, idx)["params"]
+
+
+def cross_entropy_loss(logits, targets, ignore_index: int = -1):
+    """Token cross-entropy in f32 (stable under bf16 activations)."""
+    logits = logits.astype(jnp.float32)
+    valid = targets != ignore_index
+    safe_targets = jnp.where(valid, targets, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, safe_targets[..., None],
+                             axis=-1).squeeze(-1)
+    loss = -(ll * valid).sum() / jnp.maximum(valid.sum(), 1)
+    return loss

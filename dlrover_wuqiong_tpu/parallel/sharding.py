@@ -1,0 +1,210 @@
+"""Sharding-spec library: parameter/activation PartitionSpecs for transformers.
+
+Parity: reference atorch TP modules — `RowParallelLinear`
+(`modules/distributed_modules/layers.py:239`), `ColumnParallelLinear` (:392),
+`VocabParallelEmbedding` (:549), the collective autograd functions
+(`mappings.py:302-430`) and the operator-replacement registry
+(`modules_registry.py`).
+
+TPU redesign: Megatron-style row/column parallelism is *not* hand-written
+collectives — it is a PartitionSpec per parameter plus GSPMD propagation.
+A column-parallel linear is kernel P(None, "tp"); row-parallel is
+P("tp", None) (XLA inserts the reduce-scatter/all-reduce the mappings.py
+autograd functions implement by hand).  FSDP (ZeRO-3) adds sharding of every
+param along "fsdp".  This module maps parameter *path patterns* → specs, the
+single source of truth used by trainers and the checkpoint engine.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..common.log import get_logger
+
+logger = get_logger("sharding")
+
+
+Rule = Tuple[str, P]  # (path regex, spec)
+
+
+# Default rules for transformer LMs (flax param-tree paths).  Order matters:
+# first match wins.  Conventions: embedding tables (vocab, embed);
+# attention/MLP kernels (in_features, out_features).
+TRANSFORMER_RULES: List[Rule] = [
+    # embeddings: vocab-parallel over tp (parity VocabParallelEmbedding :549)
+    (r".*(wte|embed_tokens|token_embed|embedding)/embedding$",
+     P("tp", "fsdp")),
+    (r".*(wpe|pos_embed)/embedding$", P(None, "fsdp")),
+    # attention qkv: column-parallel (heads split over tp)
+    (r".*(attn|attention).*(q_proj|k_proj|v_proj|qkv|c_attn|query|key|value)"
+     r"/kernel$", P("fsdp", "tp")),
+    # attention out: row-parallel (parity RowParallelLinear :239)
+    (r".*(attn|attention).*(o_proj|out_proj|c_proj|dense|out)/kernel$",
+     P("tp", "fsdp")),
+    # MLP up/gate: column-parallel
+    (r".*(mlp|ffn|feed_forward).*(up_proj|gate_proj|c_fc|fc1|w1|w3)/kernel$",
+     P("fsdp", "tp")),
+    # MLP down: row-parallel
+    (r".*(mlp|ffn|feed_forward).*(down_proj|c_proj|fc2|w2)/kernel$",
+     P("tp", "fsdp")),
+    # lm head: vocab-parallel
+    (r".*(lm_head|output_proj)/kernel$", P("fsdp", "tp")),
+    # biases follow their kernel's output dim
+    (r".*(q_proj|k_proj|v_proj|qkv|c_attn|up_proj|gate_proj|c_fc|fc1|w1|w3)"
+     r"/bias$", P("tp")),
+    # norms, scalars: replicated (but fsdp-shard 1D when large? keep simple)
+    (r".*(ln|norm|layernorm|rmsnorm).*", P()),
+    (r".*/bias$", P()),
+    (r".*scale$", P()),
+]
+
+MOE_RULES: List[Rule] = [
+    # expert weights: (num_experts, in, out) — experts over ep
+    (r".*experts.*(w1|w3|up|gate).*", P("ep", "fsdp", "tp")),
+    (r".*experts.*(w2|down).*", P("ep", "tp", "fsdp")),
+    (r".*(router|gate)/kernel$", P("fsdp", None)),
+]
+
+
+def path_of(key_path) -> str:
+    import jax
+
+    parts = []
+    for p in key_path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def spec_for_path(path: str, rules: Sequence[Rule],
+                  ndim: Optional[int] = None) -> P:
+    for pattern, spec in rules:
+        if re.match(pattern, path, re.IGNORECASE):
+            if ndim is not None:
+                spec = _fit_spec(spec, ndim)
+            return spec
+    return P()  # default: replicated (fsdp handled by fsdp_wrap below)
+
+
+def _fit_spec(spec: P, ndim: int) -> P:
+    """Trim/pad a spec to the array's rank."""
+    parts = list(spec)
+    if len(parts) > ndim:
+        parts = [p for p in parts if p is not None][:ndim]
+        parts += [None] * (ndim - len(parts))
+    elif len(parts) < ndim:
+        parts += [None] * (ndim - len(parts))
+    return P(*parts)
+
+
+def _add_fsdp(spec: P, shape: Tuple[int, ...], mesh: Mesh,
+              min_size: int = 2 ** 16) -> P:
+    """ZeRO-3: also shard large replicated-dim params along "fsdp".
+
+    Picks the largest dim not already sharded and divisible by the fsdp size.
+    Parity: reference FSDPOptimization (zero_optimization.py:240) auto-wrap —
+    in GSPMD it's just one more mesh axis in the spec.
+    """
+    fsdp_size = mesh.shape.get("fsdp", 1)
+    if fsdp_size <= 1:
+        return spec
+    if "fsdp" in [a for part in spec if part for a in
+                  (part if isinstance(part, tuple) else (part,))]:
+        return spec
+    import math
+
+    if math.prod(shape) < min_size:
+        return spec
+    parts = list(spec) + [None] * (len(shape) - len(spec))
+    # choose largest unsharded, divisible dim
+    best, best_size = -1, 0
+    for i, (dim, cur) in enumerate(zip(shape, parts)):
+        if cur is None and dim % fsdp_size == 0 and dim > best_size:
+            best, best_size = i, dim
+    if best < 0:
+        return spec
+    parts[best] = "fsdp"
+    return P(*parts)
+
+
+@dataclass
+class ShardingPlanner:
+    """Maps a param pytree to NamedShardings over a mesh."""
+
+    mesh: Mesh
+    rules: List[Rule] = field(default_factory=lambda:
+                              list(TRANSFORMER_RULES))
+    fsdp_min_size: int = 2 ** 16
+
+    def with_moe(self) -> "ShardingPlanner":
+        self.rules = list(MOE_RULES) + self.rules
+        return self
+
+    def param_specs(self, params: Any) -> Any:
+        """Pytree of PartitionSpec matching `params` structure."""
+        import jax
+
+        def _spec(key_path, leaf):
+            path = path_of(key_path)
+            spec = spec_for_path(path, self.rules,
+                                 ndim=getattr(leaf, "ndim", None))
+            shape = getattr(leaf, "shape", ())
+            spec = _add_fsdp(spec, tuple(shape), self.mesh,
+                             self.fsdp_min_size)
+            return spec
+
+        return jax.tree_util.tree_map_with_path(_spec, params)
+
+    def param_shardings(self, params: Any) -> Any:
+        import jax
+
+        return jax.tree.map(lambda s: NamedSharding(self.mesh, s),
+                            self.param_specs(params),
+                            is_leaf=lambda x: isinstance(x, P))
+
+    def shard_params(self, params: Any) -> Any:
+        """Place a host/replicated param pytree onto the mesh."""
+        import jax
+
+        return jax.device_put(params, self.param_shardings(params))
+
+    # ------------------------------------------------------------ activations
+
+    def batch_spec(self, ndim: int = 2, seq_axis: Optional[int] = None,
+                   batch_axis: int = 0) -> P:
+        """Batch activations: batch dim over (dp, fsdp), optional seq over sp.
+
+        `batch_axis` > 0 supports a leading grad-accum microbatch axis
+        (replicated — each accumulation step runs on the whole mesh).
+        """
+        parts: List[Any] = [None] * ndim
+        parts[batch_axis] = ("dp", "fsdp")
+        sp = self.mesh.shape.get("sp", 1)
+        if seq_axis is not None and sp > 1:
+            parts[seq_axis] = "sp"
+        return P(*parts)
+
+    def batch_sharding(self, ndim: int = 2, seq_axis: Optional[int] = None,
+                       batch_axis: int = 0) -> NamedSharding:
+        return NamedSharding(self.mesh,
+                             self.batch_spec(ndim, seq_axis, batch_axis))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+
+def constrain(x, mesh: Mesh, spec: P):
+    """In-jit sharding hint (the GSPMD equivalent of mappings.py collectives)."""
+    import jax
+
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

@@ -1,0 +1,120 @@
+"""Sharded training step builder: the hot loop, compiled once under jit.
+
+Parity: reference training hot loop after `auto_accelerate` (SURVEY.md §3.4
+tail — FSDP/TP modules with per-layer NCCL collectives).  TPU redesign: one
+jit'd step over the global mesh; GSPMD inserts all collectives from the
+in/out shardings.  Gradient accumulation (reference ElasticTrainer's fixed
+global batch) is a `lax.scan` over microbatches inside the step.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..common.log import get_logger
+from ..parallel.sharding import ShardingPlanner
+
+logger = get_logger("train_step")
+
+
+class TrainState(NamedTuple):
+    step: jax.Array
+    params: Any
+    opt_state: Any
+
+    @classmethod
+    def create(cls, params, optimizer: optax.GradientTransformation):
+        return cls(step=jnp.zeros((), jnp.int32), params=params,
+                   opt_state=optimizer.init(params))
+
+
+def make_train_step(
+    loss_fn: Callable[[Any, Any], jax.Array],
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    planner: Optional[ShardingPlanner] = None,
+    accum_steps: int = 1,
+    donate: bool = True,
+):
+    """Returns jit'd `step(state, batch) -> (state, metrics)`.
+
+    `batch` leaves have a leading microbatch axis of size `accum_steps` when
+    accumulation is on: shape (accum, per_device_batch * data_axes, ...).
+    """
+
+    def _grads(params, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        return loss, grads
+
+    def train_step(state: TrainState, batch):
+        if accum_steps == 1:
+            loss, grads = _grads(state.params, batch)
+        else:
+            def body(carry, micro):
+                loss_sum, grads_sum = carry
+                loss, grads = _grads(state.params, micro)
+                grads_sum = jax.tree.map(jnp.add, grads_sum, grads)
+                return (loss_sum + loss, grads_sum), ()
+
+            zero_grads = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+            (loss_sum, grads), _ = jax.lax.scan(
+                body, (jnp.zeros((), jnp.float32), zero_grads), batch)
+            loss = loss_sum / accum_steps
+            grads = jax.tree.map(lambda g: g / accum_steps, grads)
+        updates, opt_state = optimizer.update(grads, state.opt_state,
+                                              state.params)
+        params = optax.apply_updates(state.params, updates)
+        gnorm = optax.global_norm(grads)
+        new_state = TrainState(state.step + 1, params, opt_state)
+        return new_state, {"loss": loss, "grad_norm": gnorm}
+
+    donate_argnums = (0,) if donate else ()
+    return jax.jit(train_step, donate_argnums=donate_argnums)
+
+
+def shard_train_state(state: TrainState, planner: ShardingPlanner
+                      ) -> Tuple[TrainState, Any]:
+    """Place params/opt-state on the mesh; returns (state, state_shardings)."""
+    param_sh = planner.param_shardings(state.params)
+
+    def _opt_sharding(leaf):
+        # optimizer moments share the param sharding when shapes match
+        return None
+
+    # map opt_state leaves: match by shape against params where possible
+    flat_params = jax.tree.leaves(state.params)
+    flat_param_sh = jax.tree.leaves(
+        param_sh, is_leaf=lambda x: isinstance(x, NamedSharding))
+    shape_to_sh = {}
+    for p, sh in zip(flat_params, flat_param_sh):
+        shape_to_sh.setdefault((tuple(p.shape), str(p.dtype)), sh)
+
+    repl = planner.replicated()
+
+    def _sh_for(leaf):
+        key = (tuple(getattr(leaf, "shape", ())),
+               str(getattr(leaf, "dtype", "")))
+        return shape_to_sh.get(key, repl)
+
+    opt_sh = jax.tree.map(_sh_for, state.opt_state)
+    state_sh = TrainState(step=repl, params=param_sh, opt_state=opt_sh)
+    placed = jax.device_put(state, state_sh)
+    return placed, state_sh
+
+
+def make_lm_loss(model_apply: Callable) -> Callable:
+    """Standard causal-LM loss over a batch dict {input_ids, labels}."""
+    from ..models.gpt import cross_entropy_loss
+
+    def loss_fn(params, batch):
+        logits = model_apply({"params": params}, batch["input_ids"])
+        return cross_entropy_loss(logits, batch["labels"])
+
+    return loss_fn

@@ -1,0 +1,192 @@
+"""Parallel-training tests on the virtual 8-device CPU mesh.
+
+Covers: mesh planning, sharding rules, flash-attention numerics,
+auto_accelerate end-to-end training (loss decreases) under several strategies
+— the reference's auto_accelerate/strategy tests
+(atorch/tests/common_tests) translated to GSPMD.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from dlrover_wuqiong_tpu.auto.accelerate import (
+    auto_accelerate,
+    resolve_strategy,
+)
+from dlrover_wuqiong_tpu.models.gpt import GPT, GPTConfig
+from dlrover_wuqiong_tpu.models.llama import Llama, LlamaConfig
+from dlrover_wuqiong_tpu.ops.flash_attention import (
+    _attention_reference,
+    flash_attention,
+    mha,
+)
+from dlrover_wuqiong_tpu.parallel.mesh import (
+    MeshPlan,
+    auto_plan,
+    build_mesh,
+    hybrid_slice_plan,
+)
+from dlrover_wuqiong_tpu.parallel.sharding import (
+    ShardingPlanner,
+    TRANSFORMER_RULES,
+    spec_for_path,
+)
+
+
+class TestMeshPlan:
+    def test_build_mesh_8(self):
+        plan = MeshPlan(dp=2, fsdp=2, tp=2)
+        mesh = build_mesh(plan)
+        assert mesh.shape["dp"] == 2 and mesh.shape["tp"] == 2
+        assert mesh.devices.size == 8
+
+    def test_validate_rejects_mismatch(self):
+        with pytest.raises(ValueError):
+            build_mesh(MeshPlan(dp=3))
+
+    def test_auto_plan_small_model(self):
+        plan = auto_plan(8, num_params=10_000_000)
+        assert plan.num_devices == 8
+        assert plan.tp == 1  # no TP for small models
+
+    def test_auto_plan_huge_model_uses_tp(self):
+        plan = auto_plan(8, num_params=70_000_000_000)
+        assert plan.tp > 1
+
+    def test_hybrid_slice_plan(self):
+        plan = hybrid_slice_plan(num_slices=2, devices_per_slice=4, tp=2)
+        assert plan.dp == 2 and plan.fsdp == 2 and plan.tp == 2
+
+
+class TestShardingRules:
+    def test_attention_specs(self):
+        assert spec_for_path("h_0/attn/c_attn/kernel",
+                             TRANSFORMER_RULES) == P("fsdp", "tp")
+        assert spec_for_path("h_0/attn/c_proj/kernel",
+                             TRANSFORMER_RULES) == P("tp", "fsdp")
+        assert spec_for_path("layers_3/attention/q_proj/kernel",
+                             TRANSFORMER_RULES) == P("fsdp", "tp")
+        assert spec_for_path("wte/embedding",
+                             TRANSFORMER_RULES) == P("tp", "fsdp")
+        assert spec_for_path("h_0/ln_1/scale", TRANSFORMER_RULES) == P()
+
+    def test_planner_shards_params(self):
+        mesh = build_mesh(MeshPlan(fsdp=4, tp=2))
+        model = GPT(GPTConfig.nano())
+        params = model.init_params(jax.random.PRNGKey(0))
+        planner = ShardingPlanner(mesh)
+        sharded = planner.shard_params(params)
+        k = sharded["h_0"]["attn"]["c_attn"]["kernel"]
+        # sharded over both fsdp and tp → 8 distinct shards
+        assert len({s.index for s in k.addressable_shards}) == 8
+        # layernorm scales replicated
+        ln = sharded["h_0"]["ln_1"]["scale"]
+        assert len({s.index for s in ln.addressable_shards}) == 1
+
+
+class TestFlashAttention:
+    def test_matches_reference(self):
+        key = jax.random.PRNGKey(1)
+        q, k, v = (jax.random.normal(k_, (2, 4, 64, 32), jnp.float32)
+                   for k_ in jax.random.split(key, 3))
+        out = flash_attention(q, k, v, True, None)
+        ref = _attention_reference(q, k, v, True, 1.0 / np.sqrt(32))
+        np.testing.assert_allclose(out, ref, atol=2e-5)
+
+    def test_grad_matches_reference(self):
+        key = jax.random.PRNGKey(2)
+        q, k, v = (jax.random.normal(k_, (1, 2, 32, 16), jnp.float32)
+                   for k_ in jax.random.split(key, 3))
+
+        def f_fa(q, k, v):
+            return flash_attention(q, k, v, True, None).sum()
+
+        def f_ref(q, k, v):
+            return _attention_reference(q, k, v, True,
+                                        1.0 / np.sqrt(16)).sum()
+
+        g_fa = jax.grad(f_fa, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_fa, g_ref):
+            np.testing.assert_allclose(a, b, atol=2e-4)
+
+    def test_pallas_kernel_interpret_mode(self):
+        """Run the actual pallas kernel in interpreter mode on CPU."""
+        from dlrover_wuqiong_tpu.ops.flash_attention import (
+            _fa_forward_pallas,
+        )
+        key = jax.random.PRNGKey(3)
+        q, k, v = (jax.random.normal(k_, (2, 128, 128), jnp.float32)
+                   for k_ in jax.random.split(key, 3))
+        o, m, l = _fa_forward_pallas(q, k, v, causal=True,
+                                     sm_scale=1.0 / np.sqrt(128),
+                                     block_q=64, block_k=64, interpret=True)
+        ref = _attention_reference(q[None], k[None], v[None], True,
+                                   1.0 / np.sqrt(128))[0]
+        np.testing.assert_allclose(o, ref, atol=2e-5)
+
+
+def _toy_batch(key, accum, batch, seq, vocab):
+    data = jax.random.randint(key, (accum, batch, seq + 1), 0, vocab) \
+        if accum > 1 else jax.random.randint(key, (batch, seq + 1), 0, vocab)
+    return {"input_ids": data[..., :-1], "labels": data[..., 1:]}
+
+
+class TestAutoAccelerate:
+    def _train(self, strategy, model=None, accum=1, steps=8):
+        model = model or GPT(GPTConfig.nano())
+        res = auto_accelerate(
+            model, optimizer=optax.adamw(1e-2), strategy=strategy,
+            accum_steps=accum)
+        key = jax.random.PRNGKey(0)
+        batch = _toy_batch(key, accum, 8, 32, 16)
+        batch = res.place_batch(batch)
+        state = res.state
+        losses = []
+        for _ in range(steps):
+            state, metrics = res.train_step(state, batch)
+            losses.append(float(metrics["loss"]))
+        return losses
+
+    def test_fsdp_training_loss_decreases(self):
+        losses = self._train([("fsdp", {})])
+        assert losses[-1] < losses[0]
+
+    def test_tp_fsdp_training(self):
+        losses = self._train([("tensor_parallel", {"size": 2}),
+                              ("fsdp", {})])
+        assert losses[-1] < losses[0]
+
+    def test_dp_tp_matches_fsdp_numerics(self):
+        l1 = self._train([("fsdp", {})], steps=4)
+        l2 = self._train([("tensor_parallel", {"size": 4}),
+                          ("data_parallel", {})], steps=4)
+        np.testing.assert_allclose(l1, l2, rtol=2e-2)
+
+    def test_grad_accum(self):
+        losses = self._train([("fsdp", {}), ("grad_accum", {"steps": 2})],
+                             accum=2)
+        assert losses[-1] < losses[0]
+
+    def test_llama_model_trains(self):
+        model = Llama(LlamaConfig.nano())
+        res = auto_accelerate(model, optimizer=optax.adamw(1e-2),
+                              strategy=[("fsdp", {}),
+                                        ("tensor_parallel", {"size": 2})])
+        key = jax.random.PRNGKey(1)
+        batch = _toy_batch(key, 1, 4, 64, 16)
+        batch = res.place_batch(batch)
+        state = res.state
+        losses = []
+        for _ in range(6):
+            state, m = res.train_step(state, batch)
+            losses.append(float(m["loss"]))
+        assert losses[-1] < losses[0]
+
+    def test_unknown_strategy_raises(self):
+        with pytest.raises(ValueError, match="unknown optimization"):
+            resolve_strategy([("warp_drive", {})], 8)
