@@ -126,10 +126,17 @@ class AsyncCheckpointSaver:
             pass
         if self._thread is not None:
             self._thread.join(timeout=10)
+        clean_exit = self._thread is None or not self._thread.is_alive()
+        # wait for in-flight shard writes before touching the segments
+        self._executor.shutdown(wait=clean_exit)
         for h in self._shm_handlers.values():
             h.close()
+            if clean_exit:
+                # drop the segment: a future job must not restore it.  If the
+                # loop is wedged mid-save, keep it so the bytes survive for a
+                # post-mortem flush (the _ckpt_dir tag guards cross-job reuse).
+                h.unlink()
         self._event_queue.close()
-        self._executor.shutdown(wait=False)
 
     def _sync_shm_to_storage(self):
         """Parity: reference `_sync_shm_to_storage` :517."""

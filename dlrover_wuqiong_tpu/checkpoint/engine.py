@@ -71,10 +71,15 @@ class CheckpointEngine:
     # ------------------------------------------------------------------ save
 
     def save_to_memory(self, step: int, state: Any,
-                       extra_meta: Optional[Dict] = None) -> float:
+                       extra_meta: Optional[Dict] = None,
+                       path: Optional[str] = None) -> float:
         """Stage pytree into shm; returns blocking time in seconds."""
         t0 = time.time()
-        self._shm_handler.save_state_dict(state, step, extra_meta)
+        extra = dict(extra_meta or {})
+        # tag the segment with its checkpoint dir so a later process can't
+        # restore a stale segment left over from an unrelated job run
+        extra.setdefault("_ckpt_dir", path or self.checkpoint_dir)
+        self._shm_handler.save_state_dict(state, step, extra)
         self._latest_step = step
         return time.time() - t0
 
@@ -82,7 +87,7 @@ class CheckpointEngine:
                         path: Optional[str] = None,
                         extra_meta: Optional[Dict] = None) -> float:
         """Stage + hand off to the async saver. Returns blocking seconds."""
-        blocked = self.save_to_memory(step, state, extra_meta)
+        blocked = self.save_to_memory(step, state, extra_meta, path)
         path = path or self.checkpoint_dir
         if self._saver is not None:
             self._saver.register_path(path)
@@ -109,8 +114,11 @@ class CheckpointEngine:
         """
         shm = self._shm_handler.load_state_dict()
         if shm is not None and (step is None or shm[0] == step):
-            shm_step, flat, metas, _ = shm
-            if step is not None or shm_step >= read_last_step(
+            shm_step, flat, metas, extra = shm
+            shm_dir = extra.get("_ckpt_dir", self.checkpoint_dir)
+            if shm_dir != (path or self.checkpoint_dir):
+                shm = None  # stale segment from a different job run
+            elif step is not None or shm_step >= read_last_step(
                     path or self.checkpoint_dir, self.storage):
                 return self._assemble(
                     [dict(m.to_dict(), array=flat[m.name]) for m in metas])

@@ -166,10 +166,12 @@ class SharedMemoryHandler:
         offset = _HEADER_SIZE
         for name, leaf in flat.items():
             for ename, host, gshape, index in _leaf_entries(name, leaf):
+                # np.ascontiguousarray promotes 0-d to 1-d; keep true shape
+                shape = list(host.shape)
                 host = np.ascontiguousarray(host)
                 metas.append(TensorMeta(
                     name=ename, dtype=host.dtype.name,
-                    shape=list(host.shape), offset=offset,
+                    shape=shape, offset=offset,
                     nbytes=host.nbytes, global_shape=gshape, index=index))
                 payloads.append(host)
                 offset += host.nbytes

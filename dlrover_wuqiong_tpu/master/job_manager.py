@@ -245,7 +245,7 @@ class LocalJobManager(JobManager):
         # local processes keep their identity across restarts: reset in place
         with self._lock:
             old_node.inc_relaunch_count()
-            old_node.status = NodeStatus.INITIAL
+            old_node.status = NodeStatus.PENDING
             old_node.exit_reason = ""
             old_node.heartbeat_time = time.time()
         logger.info("local relaunch of %s (attempt %d)", old_node,
