@@ -1,0 +1,103 @@
+"""Headline benchmark: GPT-2 (124M) training throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference's nanoGPT recipe (examples/pytorch/nanogpt, the model
+behind its AGD/flash-ckpt numbers) sustains ~150k tokens/s/GPU on A100-80GB
+with torch.compile + bf16 — the customary public number for GPT-2 124M, seq
+1024 (the reference publishes only relative speedups, BASELINE.md).
+`vs_baseline` = our tokens/sec/chip divided by that 150k mark.
+
+Also measures flash-checkpoint blocking save time and MFU; reported on stderr
+so the one-line stdout contract holds.
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+BASELINE_TOKENS_PER_SEC = 150_000.0  # nanoGPT GPT-2 124M on A100, bf16
+
+
+def main():
+    import optax
+
+    from dlrover_wuqiong_tpu.auto.accelerate import auto_accelerate
+    from dlrover_wuqiong_tpu.models.gpt import GPT, GPTConfig
+
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+    if on_tpu:
+        cfg = GPTConfig.gpt2()  # 124M, seq 1024
+        batch, steps, warmup = 16, 20, 3
+    else:  # CPU smoke path so the bench is runnable anywhere
+        cfg = GPTConfig.nano()
+        batch, steps, warmup = 8, 5, 1
+    seq = cfg.block_size
+
+    res = auto_accelerate(GPT(cfg), optimizer=optax.adamw(3e-4),
+                          devices=jax.devices()[:1], strategy=[("fsdp", {})])
+    key = jax.random.PRNGKey(0)
+    data = jax.random.randint(key, (batch, seq + 1), 0, cfg.vocab_size)
+    b = res.place_batch({"input_ids": data[:, :-1], "labels": data[:, 1:]})
+
+    state = res.state
+    for _ in range(warmup):
+        state, m = res.train_step(state, b)
+    float(m["loss"])  # host readback — block_until_ready is a no-op over axon
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = res.train_step(state, b)
+    float(m["loss"])  # steps chain on state; one readback syncs them all
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = steps * batch * seq / dt
+    n_params = cfg.num_params() if hasattr(cfg, "num_params") else None
+
+    # side metrics → stderr
+    side = {"backend": backend, "seq": seq, "batch": batch,
+            "step_ms": dt / steps * 1e3}
+    if n_params:
+        side["params"] = n_params
+        flops_per_token = 6 * n_params  # fwd+bwd
+        kind = jax.devices()[0].device_kind
+        peak = {"TPU v5 lite": 197e12, "TPU v5e": 197e12, "TPU v5": 459e12,
+                "TPU v5p": 459e12, "TPU v4": 275e12,
+                "TPU v6 lite": 918e12, "TPU v6e": 918e12}.get(kind)
+        side["device_kind"] = kind
+        if peak:
+            side["mfu"] = tokens_per_sec * flops_per_token / peak
+
+    # flash-ckpt blocking save time for the train state
+    try:
+        from dlrover_wuqiong_tpu.checkpoint.checkpointer import (
+            FlashCheckpointer,
+            StorageType,
+        )
+
+        ckpt_dir = f"/tmp/dwt-bench-ckpt-{os.getpid()}"
+        ck = FlashCheckpointer(ckpt_dir, job_name=f"bench{os.getpid()}")
+        blocked = ck.save_checkpoint(int(state.step), state._asdict(),
+                                     storage_type=StorageType.DISK)
+        ck.wait_latest_checkpoint(120)
+        side["flash_ckpt_block_s"] = blocked
+        ck.close()
+    except Exception as e:  # noqa: BLE001
+        side["flash_ckpt_error"] = repr(e)
+
+    print(json.dumps(side), file=sys.stderr)
+    print(json.dumps({
+        "metric": "gpt2_124m_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(tokens_per_sec / BASELINE_TOKENS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -23,7 +23,12 @@ import jax.numpy as jnp
 import optax
 
 from ..common.log import get_logger
-from ..parallel.mesh import MeshPlan, auto_plan, build_mesh
+from ..parallel.mesh import (
+    MeshPlan,
+    auto_plan,
+    build_mesh,
+    detect_hbm_per_device,
+)
 from ..parallel.sharding import ShardingPlanner
 from ..trainer.train_step import (
     TrainState,
@@ -49,10 +54,28 @@ def register_strategy(name: str):
 class StrategyContext:
     plan: MeshPlan
     accum_steps: int = 1
-    amp: bool = True  # bf16 compute
-    remat: bool = True
-    flash_attention: bool = True
+    # tri-state: None = keep the model's own config; True/False = override
+    amp: Optional[bool] = None  # bf16 compute
+    remat: Optional[bool] = None
+    flash_attention: Optional[bool] = None
     extra: Dict = dataclasses.field(default_factory=dict)
+
+    def model_overrides(self, model) -> Dict[str, Any]:
+        """Map the flags onto the model config's field names (only fields the
+        config actually has — foreign models pass through untouched)."""
+        cfg = getattr(model, "config", None)
+        if cfg is None or not dataclasses.is_dataclass(cfg):
+            return {}
+        fields = {f.name for f in dataclasses.fields(cfg)}
+        out: Dict[str, Any] = {}
+        if self.amp is not None and "dtype" in fields:
+            out["dtype"] = jnp.bfloat16 if self.amp else jnp.float32
+        if self.remat is not None and "remat" in fields:
+            out["remat"] = self.remat
+        if self.flash_attention is not None and \
+                "use_flash_attention" in fields:
+            out["use_flash_attention"] = self.flash_attention
+        return {k: v for k, v in out.items() if getattr(cfg, k) != v}
 
 
 @register_strategy("fsdp")
@@ -91,17 +114,17 @@ def _s_pp(ctx: StrategyContext, cfg: Dict, num_devices: int):
 @register_strategy("amp_native")
 @register_strategy("half")
 def _s_amp(ctx: StrategyContext, cfg: Dict, num_devices: int):
-    ctx.amp = True
+    ctx.amp = cfg.get("enabled", True)
 
 
 @register_strategy("checkpoint")
 def _s_ckpt(ctx: StrategyContext, cfg: Dict, num_devices: int):
-    ctx.remat = True
+    ctx.remat = cfg.get("enabled", True)
 
 
 @register_strategy("module_replace")
 def _s_module_replace(ctx: StrategyContext, cfg: Dict, num_devices: int):
-    ctx.flash_attention = True
+    ctx.flash_attention = cfg.get("enabled", True)
 
 
 @register_strategy("grad_accum")
@@ -111,12 +134,15 @@ def _s_accum(ctx: StrategyContext, cfg: Dict, num_devices: int):
 
 def resolve_strategy(strategy: Optional[Sequence], num_devices: int,
                      num_params: Optional[int] = None,
-                     seq_len: int = 0) -> StrategyContext:
+                     seq_len: int = 0,
+                     hbm_per_device: Optional[int] = None) -> StrategyContext:
     """Given-strategy path (parity get_strategy :246 + adjust_strategy :305)
     or auto path (parity the engine search — heuristic here)."""
     ctx = StrategyContext(plan=MeshPlan())
     if not strategy:
-        ctx.plan = auto_plan(num_devices, num_params, seq_len=seq_len)
+        ctx.plan = auto_plan(
+            num_devices, num_params, seq_len=seq_len,
+            hbm_per_device=hbm_per_device or detect_hbm_per_device())
         return ctx
     for item in strategy:
         name, cfg = item if isinstance(item, (tuple, list)) else (item, {})
@@ -150,6 +176,7 @@ class AccelerateResult:
     strategy: StrategyContext
     loss_fn: Callable
     batch_sharding_fn: Callable  # (ndim, seq_axis) -> NamedSharding
+    model: Any = None  # the (possibly strategy-rebuilt) model
 
     def place_batch(self, batch, seq_axis: Optional[int] = None,
                     batch_axis: int = 0):
@@ -194,9 +221,19 @@ def auto_accelerate(
     if num_params is None and hasattr(model, "config") and \
             hasattr(model.config, "num_params"):
         num_params = model.config.num_params()
-    ctx = resolve_strategy(strategy, len(devices), num_params, seq_len)
+    ctx = resolve_strategy(strategy, len(devices), num_params, seq_len,
+                           hbm_per_device=detect_hbm_per_device(devices))
     if accum_steps:
         ctx.accum_steps = accum_steps
+    overrides = ctx.model_overrides(model)
+    if overrides:
+        # rebuild the model with the strategy's amp/remat/flash flags
+        new_cfg = dataclasses.replace(model.config, **overrides)
+        model = model.clone(config=new_cfg) if hasattr(model, "clone") \
+            else type(model)(new_cfg)
+        logger.info("strategy overrides model config: %s",
+                    {k: getattr(v, "__name__", v)
+                     for k, v in overrides.items()})
     mesh = build_mesh(ctx.plan, devices)
     planner = ShardingPlanner(mesh)
     if ctx.plan.ep > 1:
@@ -217,4 +254,4 @@ def auto_accelerate(
     return AccelerateResult(
         train_step=step, state=state, state_shardings=state_sh, mesh=mesh,
         planner=planner, strategy=ctx, loss_fn=loss,
-        batch_sharding_fn=planner.batch_sharding)
+        batch_sharding_fn=planner.batch_sharding, model=model)

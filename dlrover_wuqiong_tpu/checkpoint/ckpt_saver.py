@@ -79,6 +79,7 @@ class AsyncCheckpointSaver:
         self._executor = ThreadPoolExecutor(
             max_workers=max(4, local_shard_num), thread_name_prefix="ckpt-io")
         self._thread: Optional[threading.Thread] = None
+        self._inflight: List = []  # shard-write futures of the current save
         self._stopped = threading.Event()
         self._last_persisted_step = -1
         self._latest_shm_step = -1
@@ -127,8 +128,14 @@ class AsyncCheckpointSaver:
         if self._thread is not None:
             self._thread.join(timeout=10)
         clean_exit = self._thread is None or not self._thread.is_alive()
-        # wait for in-flight shard writes before touching the segments
-        self._executor.shutdown(wait=clean_exit)
+        if clean_exit and self._inflight:
+            # bounded wait for in-flight shard writes (a hung storage backend
+            # must not wedge agent teardown — mirror the thread-join bound)
+            from concurrent.futures import wait as futures_wait
+
+            done, not_done = futures_wait(self._inflight, timeout=30)
+            clean_exit = not not_done
+        self._executor.shutdown(wait=False)
         for h in self._shm_handlers.values():
             h.close()
             if clean_exit:
@@ -178,7 +185,9 @@ class AsyncCheckpointSaver:
         for local_rank, handler in self._shm_handlers.items():
             futures.append(self._executor.submit(
                 self._save_shard, handler, step, sdir, local_rank))
+        self._inflight = futures
         ok = all(f.result() for f in futures)
+        self._inflight = []
         if ok:
             self.commit_checkpoint(step, path)
             self._last_persisted_step = step

@@ -115,7 +115,8 @@ class CheckpointEngine:
         shm = self._shm_handler.load_state_dict()
         if shm is not None and (step is None or shm[0] == step):
             shm_step, flat, metas, extra = shm
-            shm_dir = extra.get("_ckpt_dir", self.checkpoint_dir)
+            # no tag (legacy/foreign segment) must NOT pass the guard
+            shm_dir = extra.get("_ckpt_dir")
             if shm_dir != (path or self.checkpoint_dir):
                 shm = None  # stale segment from a different job run
             elif step is not None or shm_step >= read_last_step(

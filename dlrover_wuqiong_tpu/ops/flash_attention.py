@@ -1,17 +1,31 @@
-"""Flash attention for TPU: Pallas kernel with online softmax + custom VJP.
+"""Flash attention for TPU: Pallas kernels (fwd + bwd) with online softmax.
 
 Parity: reference flash-attn integrations — atorch
 `modules/transformer/layers.py:1167` (`flash_attn_with_mask_bias`,
 `FlashAttnModule` :1278) and tfplus FMHA ops
 (`tfplus/tfplus/flash_attn/ops/flash_attention_ops.cc:8,39`).  Those wrap the
-CUDA flash-attn library; here the kernel is written natively in Pallas against
-the MXU/VMEM model (guide: /opt/skills/guides/pallas_guide.md).
+CUDA flash-attn library; here the kernels are written natively in Pallas
+against the MXU/VMEM model (guide: /opt/skills/guides/pallas_guide.md).
 
-Design: block-tiled over (batch*heads, q_blocks); inner loop over KV blocks
-with running max/denominator (online softmax).  Causal masking prunes
-fully-masked KV blocks via the grid.  Backward recomputes attention per block
-(memory-lean, standard FA2 scheme).  On non-TPU backends a jnp reference path
-keeps tests runnable; numerics match to bf16 tolerance.
+Design (FA2 scheme, canonical Mosaic structure):
+- the KV loop lives in the *grid* (innermost dim), not a fori_loop: Mosaic
+  double-buffers the KV block HBM→VMEM copies against compute, and the
+  q/o blocks stay resident in VMEM across the KV sweep.  Online-softmax
+  state (m, l, acc) lives in VMEM scratch that persists across grid steps;
+  `@pl.when` initializes it on the first KV step and finalizes o/lse on the
+  last.
+- causal masking is bottom-right aligned (a query at position i attends to
+  keys k_idx <= i + (sk - sq)); fully-masked KV blocks skip compute via
+  `@pl.when`.
+- backward: two kernels — dq (grid: q outer, kv inner) and dk/dv (grid: kv
+  outer, q inner) — each recomputing p = exp(s - lse) per tile so the
+  (sq, sk) attention matrix never hits HBM.  delta = rowsum(dO ∘ O) is a
+  cheap fused jnp reduction outside the kernels.
+- head_dim runs natively when lane-aligned (d % 8 == 0, e.g. GPT-2's 64);
+  otherwise it is zero-padded to the 128 boundary.  lse is carried as
+  (bh, sq) compactly in residuals and fed to kernels as (bh, sq, 1).
+- on non-TPU backends a jnp reference path keeps tests runnable; the kernels
+  themselves are additionally tested in interpret mode.
 """
 
 from __future__ import annotations
@@ -29,6 +43,8 @@ try:
 except ImportError:  # pragma: no cover
     pltpu = None
 
+NEG_INF = -1e30  # avoids inf-inf NaNs while dominating any real score
+
 
 def _on_tpu() -> bool:
     try:
@@ -37,89 +53,322 @@ def _on_tpu() -> bool:
         return False
 
 
-# --------------------------------------------------------------------- kernel
+def _compiler_params(*semantics):
+    if pltpu is None:  # pragma: no cover
+        return None
+    return pltpu.CompilerParams(dimension_semantics=semantics)
 
 
-def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, *,
-                   block_k: int, seq_k: int, causal: bool, sm_scale: float,
-                   block_q: int):
+def _dot(a, b):
+    """a @ b with native-dtype (bf16) MXU multiply, f32 accumulation."""
+    return jax.lax.dot(a, b, preferred_element_type=jnp.float32)
+
+
+def _dot_t(a, b):
+    """a @ b.T with native-dtype MXU multiply, f32 accumulation."""
+    return jax.lax.dot_general(a, b, (((1,), (1,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def _causal_mask_block(qi, ki, block_q, block_k, kv_offset):
+    q_idx = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_idx = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return q_idx + kv_offset >= k_idx
+
+
+# ------------------------------------------------------------- forward kernel
+
+
+def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                   m_scr, l_scr, acc_scr, *,
+                   num_kv: int, causal: bool, sm_scale: float,
+                   block_q: int, block_k: int, kv_offset: int):
     qi = pl.program_id(1)
-    q = q_ref[...].astype(jnp.float32) * sm_scale  # (block_q, d)
+    ki = pl.program_id(2)
 
-    m = jnp.full((block_q,), -jnp.inf, jnp.float32)
-    l = jnp.zeros((block_q,), jnp.float32)
-    acc = jnp.zeros_like(q)
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    num_k_blocks = seq_k // block_k
     if causal:
-        # highest kv block this q block attends to
-        max_kb = ((qi + 1) * block_q + block_k - 1) // block_k
-        num_iters = jnp.minimum(num_k_blocks, max_kb)
+        # block fully masked when its first key exceeds the last query's reach
+        run = (qi + 1) * block_q + kv_offset > ki * block_k
     else:
-        num_iters = num_k_blocks
+        run = True
 
-    def body(kb, carry):
-        m, l, acc = carry
-        k = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        s = q @ k.T  # (block_q, block_k)
-        if causal:
-            q_idx = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_idx = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_idx >= k_idx, s, -jnp.inf)
-        m_new = jnp.maximum(m, s.max(axis=-1))
-        # guard fully-masked rows
-        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-        p = jnp.exp(s - m_safe[:, None])
-        p = jnp.where(jnp.isfinite(s), p, 0.0)
-        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
-        l_new = l * alpha + p.sum(axis=-1)
-        acc_new = acc * alpha[:, None] + p @ v
-        return m_new, l_new, acc_new
+    def _inner(mask_block: bool):
+        # pre-scale q (block_q x d) instead of s (block_q x block_k): one
+        # fewer full VPU pass over the score matrix
+        q = (q_ref[...].astype(jnp.float32) * sm_scale).astype(q_ref.dtype)
+        k = k_ref[...]                                 # (block_k, d)
+        v = v_ref[...]
+        # bf16 MXU multiply, f32 accumulate — never cast operands up first
+        s = _dot_t(q, k)                               # (block_q, block_k)
+        if mask_block:
+            s = jnp.where(
+                _causal_mask_block(qi, ki, block_q, block_k, kv_offset),
+                s, NEG_INF)
+        m_prev = m_scr[...]                            # (block_q, 1)
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        if mask_block and kv_offset < 0:
+            # rows can be fully masked only when sq > sk: exp(0)=1 junk
+            p = jnp.where(s <= NEG_INF, 0.0, p)
+        alpha = jnp.exp(m_prev - m_new)
+        m_scr[...] = m_new
+        l_scr[...] = l_scr[...] * alpha + p.sum(axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + _dot(p.astype(v.dtype), v)
 
-    m, l, acc = jax.lax.fori_loop(0, num_iters, body, (m, l, acc))
-    l_safe = jnp.where(l > 0, l, 1.0)
-    o_ref[...] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    m_ref[...] = m
-    l_ref[...] = l
+    if causal:
+        # only blocks straddling the diagonal pay for mask generation
+        diag = (qi * block_q + kv_offset < (ki + 1) * block_k) & run
+
+        @pl.when(diag)
+        def _compute_masked():
+            _inner(True)
+
+        @pl.when(jnp.logical_not(diag) & run)
+        def _compute_unmasked():
+            _inner(False)
+    else:
+
+        @pl.when(run)
+        def _compute():
+            _inner(False)
+
+    @pl.when(ki == num_kv - 1)
+    def _finalize():
+        l = l_scr[...]
+        l_safe = jnp.where(l > 0, l, 1.0)
+        o_ref[...] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+        lse = jnp.where(l > 0, m_scr[...] + jnp.log(l_safe), jnp.inf)
+        lse_ref[...] = lse
 
 
 def _fa_forward_pallas(q, k, v, causal: bool, sm_scale: float,
                        block_q: int, block_k: int, interpret: bool):
-    """q: (bh, sq, d), k/v: (bh, sk, d) → (o, m, l)"""
+    """q: (bh, sq, d), k/v: (bh, sk, d) → (o, lse (bh, sq, 1) f32)."""
     bh, sq, d = q.shape
     sk = k.shape[1]
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
-    grid = (bh, sq // block_q)
+    num_kv = sk // block_k
+    grid = (bh, sq // block_q, num_kv)
 
     kernel = functools.partial(
-        _fa_fwd_kernel, block_k=block_k, seq_k=sk, causal=causal,
-        sm_scale=sm_scale, block_q=block_q)
-    out_shapes = (
-        jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-        jax.ShapeDtypeStruct((bh, sq), jnp.float32),
-        jax.ShapeDtypeStruct((bh, sq), jnp.float32),
-    )
-    o, m, l = pl.pallas_call(
+        _fa_fwd_kernel, num_kv=num_kv, causal=causal, sm_scale=sm_scale,
+        block_q=block_q, block_k=block_k, kv_offset=sk - sq)
+    o, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, sk, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=(
-            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, block_q), lambda b, i: (b, i)),
-            pl.BlockSpec((None, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, i, 0)),
         ),
-        out_shape=out_shapes,
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ] if pltpu is not None else [],
+        compiler_params=_compiler_params("parallel", "parallel", "arbitrary"),
         interpret=interpret,
     )(q, k, v)
-    return o, m, l
+    return o, lse
+
+
+# ------------------------------------------------------------ backward kernels
+
+
+def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dq_scr, *, num_kv: int, causal: bool,
+                      sm_scale: float, block_q: int, block_k: int,
+                      kv_offset: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    if causal:
+        run = (qi + 1) * block_q + kv_offset > ki * block_k
+    else:
+        run = True
+
+    def _inner(mask_block: bool):
+        q = (q_ref[...].astype(jnp.float32) * sm_scale).astype(q_ref.dtype)
+        k = k_ref[...]
+        v = v_ref[...]
+        do = do_ref[...]
+        lse = lse_ref[...]                      # (block_q, 1)
+        delta = delta_ref[...]                  # (block_q, 1)
+        s = _dot_t(q, k)
+        if mask_block:
+            s = jnp.where(
+                _causal_mask_block(qi, ki, block_q, block_k, kv_offset),
+                s, NEG_INF)
+        p = jnp.exp(s - lse)                    # 0 where masked / lse=inf
+        dp = _dot_t(do, v)
+        ds = (p * (dp - delta) * sm_scale).astype(q.dtype)
+        dq_scr[...] += _dot(ds, k)
+
+    if causal:
+        diag = (qi * block_q + kv_offset < (ki + 1) * block_k) & run
+
+        @pl.when(diag)
+        def _compute_masked():
+            _inner(True)
+
+        @pl.when(jnp.logical_not(diag) & run)
+        def _compute_unmasked():
+            _inner(False)
+    else:
+
+        @pl.when(run)
+        def _compute():
+            _inner(False)
+
+    @pl.when(ki == num_kv - 1)
+    def _finalize():
+        dq_ref[...] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                       dk_ref, dv_ref, dk_scr, dv_scr, *, num_q: int,
+                       causal: bool, sm_scale: float, block_q: int,
+                       block_k: int, kv_offset: int):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    if causal:
+        run = (qi + 1) * block_q + kv_offset > ki * block_k
+    else:
+        run = True
+
+    def _inner(mask_block: bool):
+        qs = (q_ref[...].astype(jnp.float32) * sm_scale).astype(q_ref.dtype)
+        q = q_ref[...]
+        k = k_ref[...]
+        v = v_ref[...]
+        do = do_ref[...]
+        lse = lse_ref[...]
+        delta = delta_ref[...]
+        s = _dot_t(qs, k)                       # (block_q, block_k)
+        if mask_block:
+            s = jnp.where(
+                _causal_mask_block(qi, ki, block_q, block_k, kv_offset),
+                s, NEG_INF)
+        p = jnp.exp(s - lse).astype(q.dtype)
+        dv_scr[...] += _dot(p.T, do)
+        dp = _dot_t(do, v)
+        ds = (p.astype(jnp.float32) * (dp - delta) * sm_scale).astype(q.dtype)
+        dk_scr[...] += _dot(ds.T, q)
+
+    if causal:
+        diag = (qi * block_q + kv_offset < (ki + 1) * block_k) & run
+
+        @pl.when(diag)
+        def _compute_masked():
+            _inner(True)
+
+        @pl.when(jnp.logical_not(diag) & run)
+        def _compute_unmasked():
+            _inner(False)
+    else:
+
+        @pl.when(run)
+        def _compute():
+            _inner(False)
+
+    @pl.when(qi == num_q - 1)
+    def _finalize():
+        dk_ref[...] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _fa_backward_pallas(q, k, v, o, lse, do, causal: bool, sm_scale: float,
+                        block_q: int, block_k: int, interpret: bool):
+    """All operands flat (bh, s, d); lse (bh, sq, 1). Returns dq, dk, dv."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    kv_offset = sk - sq
+    num_q = sq // block_q
+    num_kv = sk // block_k
+
+    # delta = rowsum(dO ∘ O) — cheap elementwise reduce, XLA fuses it
+    delta = (do.astype(jnp.float32) * o.astype(jnp.float32)).sum(
+        -1, keepdims=True)  # (bh, sq, 1)
+
+    dq = pl.pallas_call(
+        functools.partial(_fa_bwd_dq_kernel, num_kv=num_kv, causal=causal,
+                          sm_scale=sm_scale, block_q=block_q,
+                          block_k=block_k, kv_offset=kv_offset),
+        grid=(bh, num_q, num_kv),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)]
+        if pltpu is not None else [],
+        compiler_params=_compiler_params("parallel", "parallel", "arbitrary"),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_fa_bwd_dkv_kernel, num_q=num_q, causal=causal,
+                          sm_scale=sm_scale, block_q=block_q,
+                          block_k=block_k, kv_offset=kv_offset),
+        grid=(bh, num_kv, num_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((None, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((None, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, j, i: (b, j, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ] if pltpu is not None else [],
+        compiler_params=_compiler_params("parallel", "parallel", "arbitrary"),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
 
 
 # ----------------------------------------------------------------- reference
@@ -145,7 +394,7 @@ def _attention_reference(q, k, v, causal: bool, sm_scale: float):
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, causal: bool = True,
                     sm_scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128):
+                    block_q: int = 256, block_k: int = 512):
     """Multi-head attention, FA2-style.
 
     Args: q (b, h, sq, d); k, v (b, h, sk, d).  Returns (b, h, sq, d).
@@ -161,32 +410,68 @@ def _resolve_scale(sm_scale, d):
 def _use_pallas(sq, sk, d, block_q, block_k) -> bool:
     if not _on_tpu():
         return False
-    # pallas path needs tile-able shapes
-    return (sq % min(block_q, sq) == 0 and sk % min(block_k, sk) == 0
-            and d % 128 == 0)
+    # pallas path needs tile-able sequence lengths; head_dim runs natively
+    # (lane-aligned) or zero-padded inside _fa_fwd, so any d qualifies
+    return sq % min(block_q, sq) == 0 and sk % min(block_k, sk) == 0
+
+
+def _kernel_head_dim(d: int) -> int:
+    """Head dim as seen by the kernels.
+
+    Mosaic accepts any block whose last dim equals the array's, so lane-
+    aligned head dims (multiples of 8) run natively — d=64 (GPT-2) included,
+    avoiding pad copies.  Odd dims are zero-padded to the 128-lane boundary
+    (padded q/k columns add 0 to scores; padded v columns are sliced off).
+    """
+    return d if d % 8 == 0 else max(128, -(-d // 128) * 128)
+
+
+def _pad_head_dim(x, d_pad):
+    d = x.shape[-1]
+    if d == d_pad:
+        return x
+    return jnp.pad(x, ((0, 0), (0, 0), (0, d_pad - d)))
+
+
+def _flat_padded(q, k, v, d_pad):
+    b, h, sq, d = q.shape
+    qf = _pad_head_dim(q.reshape(b * h, sq, d), d_pad)
+    kf = _pad_head_dim(k.reshape(b * h, k.shape[2], d), d_pad)
+    vf = _pad_head_dim(v.reshape(b * h, v.shape[2], d), d_pad)
+    return qf, kf, vf
 
 
 def _fa_fwd(q, k, v, causal, sm_scale, block_q, block_k):
     b, h, sq, d = q.shape
     scale = _resolve_scale(sm_scale, d)
     if _use_pallas(sq, k.shape[2], d, block_q, block_k):
-        qf = q.reshape(b * h, sq, d)
-        kf = k.reshape(b * h, k.shape[2], d)
-        vf = v.reshape(b * h, v.shape[2], d)
-        o, m, l = _fa_forward_pallas(qf, kf, vf, causal, scale, block_q,
-                                     block_k, interpret=False)
-        out = o.reshape(b, h, sq, d)
-        return out, (q, k, v, out, m.reshape(b, h, sq), l.reshape(b, h, sq))
+        d_pad = _kernel_head_dim(d)
+        qf, kf, vf = _flat_padded(q, k, v, d_pad)
+        o, lse = _fa_forward_pallas(qf, kf, vf, causal, scale, block_q,
+                                    block_k, interpret=False)
+        out = o[:, :, :d].reshape(b, h, sq, d)
+        # keep residuals compact: lse (bh, sq, 1) has a 128x-padded layout
+        return out, (q, k, v, o, lse[..., 0])
     out = _attention_reference(q, k, v, causal, scale)
-    return out, (q, k, v, out, None, None)
+    return out, (q, k, v, out, None)
 
 
 def _fa_bwd(causal, sm_scale, block_q, block_k, res, g):
-    q, k, v, out, m, l = res
+    q, k, v, out, lse = res
     b, h, sq, d = q.shape
     scale = _resolve_scale(sm_scale, d)
-    # recompute-based backward (XLA fuses this well; a fully hand-written
-    # pallas bwd kernel is a later optimization)
+    if lse is not None:  # pallas forward ran: pallas backward
+        d_pad = _kernel_head_dim(d)
+        qf, kf, vf = _flat_padded(q, k, v, d_pad)
+        gf = _pad_head_dim(g.reshape(b * h, sq, d), d_pad)
+        dq, dk, dv = _fa_backward_pallas(qf, kf, vf, out, lse[..., None],
+                                         gf, causal, scale, block_q, block_k,
+                                         interpret=False)
+        sk = k.shape[2]
+        return (dq[:, :, :d].reshape(b, h, sq, d).astype(q.dtype),
+                dk[:, :, :d].reshape(b, h, sk, d).astype(k.dtype),
+                dv[:, :, :d].reshape(b, h, sk, d).astype(v.dtype))
+    # jnp recompute fallback (matches _attention_reference numerics)
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
     if causal:
         sk = s.shape[-1]

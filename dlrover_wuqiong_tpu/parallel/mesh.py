@@ -109,9 +109,33 @@ def auto_plan(num_devices: int, num_params: Optional[int] = None,
     # weights overlap with compute under XLA latency hiding)
     plan.fsdp = remaining
     plan.validate(num_devices)
+    if num_params:
+        # enforce the fit: state must shard across enough devices.  sp/ep
+        # don't shard the optimizer state, so only tp*fsdp counts.
+        if plan.tp * plan.fsdp < min_shards:
+            raise ValueError(
+                f"model state (~{num_params * 14 / 1e9:.0f} GB) does not fit: "
+                f"needs ≥{min_shards} state shards but plan "
+                f"{plan.describe()} provides {plan.tp * plan.fsdp} "
+                f"(devices with ≥{hbm_per_device >> 30} GiB HBM)")
     logger.info("auto mesh plan for %d devices: %s", num_devices,
                 plan.describe())
     return plan
+
+
+def detect_hbm_per_device(devices: Optional[Sequence] = None) -> int:
+    """Per-device accelerator memory, from the runtime when available."""
+    try:
+        import jax
+
+        devices = devices or jax.devices()
+        stats = devices[0].memory_stats()
+        limit = int(stats.get("bytes_limit", 0)) if stats else 0
+        if limit > 0:
+            return limit
+    except Exception:  # noqa: BLE001 — CPU/older runtimes have no stats
+        pass
+    return 16 << 30
 
 
 def _largest_pow2_leq(n: int) -> int:

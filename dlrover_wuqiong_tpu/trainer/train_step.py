@@ -15,7 +15,7 @@ from typing import Any, Callable, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import optax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from ..common.log import get_logger
 from ..parallel.sharding import ShardingPlanner
@@ -83,27 +83,32 @@ def shard_train_state(state: TrainState, planner: ShardingPlanner
                       ) -> Tuple[TrainState, Any]:
     """Place params/opt-state on the mesh; returns (state, state_shardings)."""
     param_sh = planner.param_shardings(state.params)
-
-    def _opt_sharding(leaf):
-        # optimizer moments share the param sharding when shapes match
-        return None
-
-    # map opt_state leaves: match by shape against params where possible
-    flat_params = jax.tree.leaves(state.params)
-    flat_param_sh = jax.tree.leaves(
-        param_sh, is_leaf=lambda x: isinstance(x, NamedSharding))
-    shape_to_sh = {}
-    for p, sh in zip(flat_params, flat_param_sh):
-        shape_to_sh.setdefault((tuple(p.shape), str(p.dtype)), sh)
-
     repl = planner.replicated()
 
-    def _sh_for(leaf):
-        key = (tuple(getattr(leaf, "shape", ())),
-               str(getattr(leaf, "dtype", "")))
-        return shape_to_sh.get(key, repl)
+    # optimizer moments (adam mu/nu, etc.) mirror the param pytree: any
+    # opt_state subtree whose structure equals the param tree gets the param
+    # shardings leaf-for-leaf; everything else (counts, scalars) replicates.
+    # Matching by position, not shape — two same-shaped params can carry
+    # different PartitionSpecs (e.g. P('fsdp','tp') vs P('tp','fsdp')).
+    param_treedef = jax.tree.structure(state.params)
+    param_shapes = [getattr(p, "shape", None)
+                    for p in jax.tree.leaves(state.params)]
 
-    opt_sh = jax.tree.map(_sh_for, state.opt_state)
+    def _is_param_shaped(sub):
+        # structure alone is not enough: adafactor's v_row/v_col subtrees
+        # mirror the param treedef with reduced leaf shapes
+        try:
+            if jax.tree.structure(sub) != param_treedef:
+                return False
+            return [getattr(x, "shape", None)
+                    for x in jax.tree.leaves(sub)] == param_shapes
+        except Exception:  # noqa: BLE001
+            return False
+
+    opt_sh = jax.tree.map(
+        lambda sub: (param_sh if _is_param_shaped(sub)
+                     else jax.tree.map(lambda _: repl, sub)),
+        state.opt_state, is_leaf=_is_param_shaped)
     state_sh = TrainState(step=repl, params=param_sh, opt_state=opt_sh)
     placed = jax.device_put(state, state_sh)
     return placed, state_sh

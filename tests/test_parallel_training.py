@@ -54,8 +54,16 @@ class TestMeshPlan:
         assert plan.tp == 1  # no TP for small models
 
     def test_auto_plan_huge_model_uses_tp(self):
-        plan = auto_plan(8, num_params=70_000_000_000)
+        # 70B fits on 64 v5p-class chips (95 GiB HBM) and engages TP
+        plan = auto_plan(64, num_params=70_000_000_000,
+                         hbm_per_device=95 << 30)
         assert plan.tp > 1
+
+    def test_auto_plan_rejects_state_that_cannot_fit(self):
+        # 70B state (~980 GB) cannot fit 8 x 16 GiB chips: planner must say
+        # so instead of emitting a plan that OOMs at runtime
+        with pytest.raises(ValueError, match="does not fit"):
+            auto_plan(8, num_params=70_000_000_000)
 
     def test_hybrid_slice_plan(self):
         plan = hybrid_slice_plan(num_slices=2, devices_per_slice=4, tp=2)
@@ -122,12 +130,78 @@ class TestFlashAttention:
         key = jax.random.PRNGKey(3)
         q, k, v = (jax.random.normal(k_, (2, 128, 128), jnp.float32)
                    for k_ in jax.random.split(key, 3))
-        o, m, l = _fa_forward_pallas(q, k, v, causal=True,
+        o, _ = _fa_forward_pallas(q, k, v, causal=True,
                                      sm_scale=1.0 / np.sqrt(128),
                                      block_q=64, block_k=64, interpret=True)
         ref = _attention_reference(q[None], k[None], v[None], True,
                                    1.0 / np.sqrt(128))[0]
         np.testing.assert_allclose(o, ref, atol=2e-5)
+
+    def test_pallas_kernel_causal_sq_ne_sk(self):
+        """Bottom-right-aligned causal mask when sq != sk (decode append)."""
+        from dlrover_wuqiong_tpu.ops.flash_attention import (
+            _fa_forward_pallas,
+        )
+        key = jax.random.PRNGKey(4)
+        kq, kk, kv = jax.random.split(key, 3)
+        q = jax.random.normal(kq, (2, 64, 128), jnp.float32)
+        k = jax.random.normal(kk, (2, 256, 128), jnp.float32)
+        v = jax.random.normal(kv, (2, 256, 128), jnp.float32)
+        o, _ = _fa_forward_pallas(q, k, v, causal=True,
+                                     sm_scale=1.0 / np.sqrt(128),
+                                     block_q=64, block_k=64, interpret=True)
+        ref = _attention_reference(q[None], k[None], v[None], True,
+                                   1.0 / np.sqrt(128))[0]
+        np.testing.assert_allclose(o, ref, atol=2e-5)
+
+    def test_pallas_kernel_padded_head_dim(self):
+        """d=64 (GPT-2 heads) rides the kernel via zero-padding to 128."""
+        from dlrover_wuqiong_tpu.ops.flash_attention import (
+            _fa_forward_pallas,
+            _pad_head_dim,
+        )
+        key = jax.random.PRNGKey(5)
+        q, k, v = (jax.random.normal(k_, (2, 128, 64), jnp.float32)
+                   for k_ in jax.random.split(key, 3))
+        qp, kp, vp = (_pad_head_dim(x, 128) for x in (q, k, v))
+        o, _ = _fa_forward_pallas(qp, kp, vp, causal=True,
+                                     sm_scale=1.0 / np.sqrt(64),
+                                     block_q=64, block_k=64, interpret=True)
+        ref = _attention_reference(q[None], k[None], v[None], True,
+                                   1.0 / np.sqrt(64))[0]
+        np.testing.assert_allclose(o[:, :, :64], ref, atol=2e-5)
+
+    @pytest.mark.parametrize("causal,sq,sk", [(True, 128, 128),
+                                              (False, 128, 128),
+                                              (True, 64, 256)])
+    def test_pallas_backward_kernel(self, causal, sq, sk):
+        """dq/dk/dv kernels vs autodiff-of-reference, interpret mode."""
+        from dlrover_wuqiong_tpu.ops.flash_attention import (
+            _fa_backward_pallas,
+            _fa_forward_pallas,
+        )
+        key = jax.random.PRNGKey(6)
+        kq, kk, kv, kg = jax.random.split(key, 4)
+        scale = 1.0 / np.sqrt(128)
+        q = jax.random.normal(kq, (2, sq, 128), jnp.float32)
+        k = jax.random.normal(kk, (2, sk, 128), jnp.float32)
+        v = jax.random.normal(kv, (2, sk, 128), jnp.float32)
+        g = jax.random.normal(kg, (2, sq, 128), jnp.float32)
+
+        o, lse = _fa_forward_pallas(q, k, v, causal, scale, 64, 64,
+                                    interpret=True)
+        dq, dk, dv = _fa_backward_pallas(q, k, v, o, lse, g, causal, scale,
+                                         64, 64, interpret=True)
+
+        def ref_loss(q, k, v):
+            out = _attention_reference(q[None], k[None], v[None], causal,
+                                       scale)[0]
+            return (out * g).sum()
+
+        rq, rk, rv = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+        np.testing.assert_allclose(dq, rq, atol=5e-4)
+        np.testing.assert_allclose(dk, rk, atol=5e-4)
+        np.testing.assert_allclose(dv, rv, atol=5e-4)
 
 
 def _toy_batch(key, accum, batch, seq, vocab):
@@ -171,6 +245,33 @@ class TestAutoAccelerate:
         losses = self._train([("fsdp", {}), ("grad_accum", {"steps": 2})],
                              accum=2)
         assert losses[-1] < losses[0]
+
+    def test_strategy_flags_reach_model_config(self):
+        model = GPT(GPTConfig.nano())
+        assert model.config.dtype == jnp.bfloat16
+        res = auto_accelerate(
+            model, optimizer=optax.adamw(1e-2),
+            strategy=[("fsdp", {}), ("half", {"enabled": False}),
+                      ("checkpoint", {"enabled": False})])
+        # the result carries the rebuilt model with the overridden flags
+        assert res.model.config.dtype == jnp.float32
+        assert res.model.config.remat is False
+
+    def test_adafactor_opt_state_shards(self):
+        """Factored states mirror the param treedef with reduced leaf shapes:
+        they must NOT inherit param shardings (regression test)."""
+        model = GPT(GPTConfig.nano())
+        res = auto_accelerate(
+            model, optimizer=optax.adafactor(1e-3),
+            strategy=[("fsdp", {}), ("tensor_parallel", {"size": 2})])
+        batch = _toy_batch(jax.random.PRNGKey(0), 1, 4, 32, 16)
+        state, m = res.train_step(res.state, res.place_batch(batch))
+        assert np.isfinite(float(m["loss"]))
+
+    def test_unknown_strategy_rejected(self):
+        with pytest.raises(ValueError, match="unknown optimization strategy"):
+            auto_accelerate(GPT(GPTConfig.nano()),
+                            strategy=[("fsdppp", {})])
 
     def test_llama_model_trains(self):
         model = Llama(LlamaConfig.nano())
