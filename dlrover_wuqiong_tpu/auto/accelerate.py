@@ -99,6 +99,9 @@ def _s_tp(ctx: StrategyContext, cfg: Dict, num_devices: int):
 @register_strategy("sequence_parallel")
 def _s_sp(ctx: StrategyContext, cfg: Dict, num_devices: int):
     ctx.plan.sp = cfg.get("size", 1)
+    # "ulysses" (all-to-all head scatter) | "ring" (ppermute KV rotation,
+    # O(S/sp) memory — long context) | "gspmd" (let XLA all-gather KV)
+    ctx.extra["sp_impl"] = cfg.get("impl", "ulysses")
 
 
 @register_strategy("expert_parallel")
@@ -238,6 +241,25 @@ def auto_accelerate(
     planner = ShardingPlanner(mesh)
     if ctx.plan.ep > 1:
         planner.with_moe()
+    sp_impl = ctx.extra.get("sp_impl", "ulysses")
+    if ctx.plan.sp > 1 and sp_impl != "gspmd" and \
+            hasattr(model, "config") and \
+            dataclasses.is_dataclass(model.config) and \
+            any(f.name == "attn_impl"
+                for f in dataclasses.fields(model.config)):
+        # context-parallel attention: ring (ppermute) or Ulysses (all-to-all)
+        heads = getattr(model.config, "n_head",
+                        getattr(model.config, "num_heads", None))
+        if sp_impl == "ulysses" and heads and heads % ctx.plan.sp:
+            raise ValueError(
+                f"ulysses sequence parallel needs heads ({heads}) divisible "
+                f"by sp={ctx.plan.sp}; use impl='ring' or adjust sp")
+        new_cfg = dataclasses.replace(model.config, attn_impl=sp_impl,
+                                      mesh=mesh)
+        model = model.clone(config=new_cfg) if hasattr(model, "clone") \
+            else type(model)(new_cfg)
+        logger.info("sequence parallel: %s attention over sp=%d", sp_impl,
+                    ctx.plan.sp)
 
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     params = model.init_params(rng)

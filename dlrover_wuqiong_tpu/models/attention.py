@@ -1,0 +1,25 @@
+"""Attention dispatch for model modules: flash / ring / Ulysses.
+
+The model config carries `attn_impl` ("flash" | "ring" | "ulysses") and,
+for the SP impls, the `mesh` whose `sp` axis shards the sequence.  The
+`sequence_parallel` strategy (auto/accelerate.py) rewrites these fields so
+the same model definition runs single-chip, GSPMD-sharded, or
+context-parallel without code changes.
+"""
+
+from __future__ import annotations
+
+from ..ops.flash_attention import mha
+
+
+def attend(q, k, v, cfg, causal: bool = True):
+    """q/k/v in flax layout (b, T, h, d); returns (b, T, h, d)."""
+    impl = getattr(cfg, "attn_impl", "flash")
+    mesh = getattr(cfg, "mesh", None)
+    if impl in ("ring", "ulysses") and mesh is not None:
+        from ..parallel.long_context import ring_attention, ulysses_attention
+
+        fn = ring_attention if impl == "ring" else ulysses_attention
+        qt, kt, vt = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        return fn(qt, kt, vt, mesh, causal=causal).transpose(0, 2, 1, 3)
+    return mha(q, k, v, causal=causal)

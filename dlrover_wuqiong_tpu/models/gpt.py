@@ -17,7 +17,6 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from ..ops.flash_attention import mha
 
 
 @dataclasses.dataclass(frozen=True)
@@ -31,6 +30,8 @@ class GPTConfig:
     dtype: Any = jnp.bfloat16
     remat: bool = True
     use_flash_attention: bool = True
+    attn_impl: str = "flash"  # "flash" | "ring" | "ulysses"
+    mesh: Any = None  # required by ring/ulysses (set by auto_accelerate)
 
     @classmethod
     def nano(cls):  # tiny config for tests
@@ -77,7 +78,9 @@ class CausalSelfAttention(nn.Module):
         k = k.reshape(B, T, cfg.n_head, cfg.head_dim)
         v = v.reshape(B, T, cfg.n_head, cfg.head_dim)
         if cfg.use_flash_attention:
-            y = mha(q, k, v, causal=True)
+            from .attention import attend
+
+            y = attend(q, k, v, cfg, causal=True)
         else:
             att = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
                 jnp.float32(cfg.head_dim)).astype(cfg.dtype)

@@ -16,7 +16,6 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from ..ops.flash_attention import mha
 
 
 @dataclasses.dataclass(frozen=True)
@@ -33,6 +32,8 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     remat: bool = True
     use_flash_attention: bool = True
+    attn_impl: str = "flash"  # "flash" | "ring" | "ulysses"
+    mesh: Any = None  # required by ring/ulysses (set by auto_accelerate)
 
     @classmethod
     def nano(cls):
@@ -117,7 +118,9 @@ class LlamaAttention(nn.Module):
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
         if cfg.use_flash_attention:
-            y = mha(q, k, v, causal=True)
+            from .attention import attend
+
+            y = attend(q, k, v, cfg, causal=True)
         else:
             att = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(
                 jnp.float32) / jnp.sqrt(jnp.float32(hd))

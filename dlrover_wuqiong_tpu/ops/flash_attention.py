@@ -305,8 +305,12 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _fa_backward_pallas(q, k, v, o, lse, do, causal: bool, sm_scale: float,
-                        block_q: int, block_k: int, interpret: bool):
-    """All operands flat (bh, s, d); lse (bh, sq, 1). Returns dq, dk, dv."""
+                        block_q: int, block_k: int, interpret: bool,
+                        glse=None):
+    """All operands flat (bh, s, d); lse (bh, sq, 1). Returns dq, dk, dv.
+
+    `glse` (bh, sq, 1): optional cotangent of the lse output — since
+    d lse / d s = p, it folds into delta (ds = p * (dp - delta + glse))."""
     bh, sq, d = q.shape
     sk = k.shape[1]
     block_q = min(block_q, sq)
@@ -318,6 +322,8 @@ def _fa_backward_pallas(q, k, v, o, lse, do, causal: bool, sm_scale: float,
     # delta = rowsum(dO ∘ O) — cheap elementwise reduce, XLA fuses it
     delta = (do.astype(jnp.float32) * o.astype(jnp.float32)).sum(
         -1, keepdims=True)  # (bh, sq, 1)
+    if glse is not None:
+        delta = delta - glse
 
     dq = pl.pallas_call(
         functools.partial(_fa_bwd_dq_kernel, num_kv=num_kv, causal=causal,
@@ -407,12 +413,27 @@ def _resolve_scale(sm_scale, d):
     return sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
 
 
+def _fit_block(seq: int, pref: int) -> Optional[int]:
+    """Largest block ≤ pref that tiles `seq`; None if nothing reasonable.
+
+    Falls back through the standard tile sizes so e.g. seq=640 still rides
+    the kernel with block 128 instead of silently hitting the dense path.
+    A block equal to the whole (modest) sequence is always legal — Mosaic
+    accepts blocks equal to the array dimension.
+    """
+    for b in (pref, 1024, 512, 256, 128, 64, 32, 16, 8):
+        if b <= pref and b <= seq and seq % b == 0:
+            return b
+    return seq if seq <= 2048 else None
+
+
 def _use_pallas(sq, sk, d, block_q, block_k) -> bool:
     if not _on_tpu():
         return False
-    # pallas path needs tile-able sequence lengths; head_dim runs natively
-    # (lane-aligned) or zero-padded inside _fa_fwd, so any d qualifies
-    return sq % min(block_q, sq) == 0 and sk % min(block_k, sk) == 0
+    # head_dim runs natively (lane-aligned) or zero-padded, so any d
+    # qualifies; sequences need a workable tile size
+    return (_fit_block(sq, block_q) is not None
+            and _fit_block(sk, block_k) is not None)
 
 
 def _kernel_head_dim(d: int) -> int:
@@ -441,40 +462,65 @@ def _flat_padded(q, k, v, d_pad):
     return qf, kf, vf
 
 
-def _fa_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+def _fa_fwd_lse(q, k, v, causal, sm_scale, block_q, block_k):
+    """Shared forward: returns ((out, lse_bhs), residuals)."""
     b, h, sq, d = q.shape
+    sk = k.shape[2]
     scale = _resolve_scale(sm_scale, d)
-    if _use_pallas(sq, k.shape[2], d, block_q, block_k):
+    if _use_pallas(sq, sk, d, block_q, block_k):
+        bq = _fit_block(sq, block_q)
+        bk = _fit_block(sk, block_k)
         d_pad = _kernel_head_dim(d)
         qf, kf, vf = _flat_padded(q, k, v, d_pad)
-        o, lse = _fa_forward_pallas(qf, kf, vf, causal, scale, block_q,
-                                    block_k, interpret=False)
+        o, lse = _fa_forward_pallas(qf, kf, vf, causal, scale, bq, bk,
+                                    interpret=False)
         out = o[:, :, :d].reshape(b, h, sq, d)
         # keep residuals compact: lse (bh, sq, 1) has a 128x-padded layout
-        return out, (q, k, v, o, lse[..., 0])
-    out = _attention_reference(q, k, v, causal, scale)
-    return out, (q, k, v, out, None)
+        lse_c = lse[..., 0]
+        return (out, lse_c.reshape(b, h, sq)), (q, k, v, o, lse_c)
+    out, lse = _reference_with_lse(q, k, v, causal, scale)
+    return (out, lse), (q, k, v, out, None)
 
 
-def _fa_bwd(causal, sm_scale, block_q, block_k, res, g):
+def _reference_with_lse(q, k, v, causal, scale):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask, s, -jnp.inf)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    m = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(s - m)
+    l = p.sum(-1, keepdims=True)
+    l_safe = jnp.where(l > 0, l, 1.0)
+    lse = jnp.where(l[..., 0] > 0, (m + jnp.log(l_safe))[..., 0], -jnp.inf)
+    o = jnp.einsum("bhqk,bhkd->bhqd", (p / l_safe).astype(v.dtype), v)
+    return o, lse
+
+
+def _fa_bwd_impl(causal, sm_scale, block_q, block_k, res, g, glse):
+    """Shared backward; glse (b, h, sq) f32 or None folds the lse cotangent
+    into delta (d lse / d s = p, so ds = p * (dp - delta + glse))."""
     q, k, v, out, lse = res
     b, h, sq, d = q.shape
+    sk = k.shape[2]
     scale = _resolve_scale(sm_scale, d)
     if lse is not None:  # pallas forward ran: pallas backward
+        bq = _fit_block(sq, block_q)
+        bk = _fit_block(sk, block_k)
         d_pad = _kernel_head_dim(d)
         qf, kf, vf = _flat_padded(q, k, v, d_pad)
         gf = _pad_head_dim(g.reshape(b * h, sq, d), d_pad)
+        glse_f = None if glse is None else glse.reshape(b * h, sq, 1)
         dq, dk, dv = _fa_backward_pallas(qf, kf, vf, out, lse[..., None],
-                                         gf, causal, scale, block_q, block_k,
-                                         interpret=False)
-        sk = k.shape[2]
+                                         gf, causal, scale, bq, bk,
+                                         interpret=False, glse=glse_f)
         return (dq[:, :, :d].reshape(b, h, sq, d).astype(q.dtype),
                 dk[:, :, :d].reshape(b, h, sk, d).astype(k.dtype),
                 dv[:, :, :d].reshape(b, h, sk, d).astype(v.dtype))
     # jnp recompute fallback (matches _attention_reference numerics)
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
     if causal:
-        sk = s.shape[-1]
         mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
         s = jnp.where(mask, s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
@@ -482,6 +528,8 @@ def _fa_bwd(causal, sm_scale, block_q, block_k, res, g):
     v32 = v.astype(jnp.float32)
     dp = jnp.einsum("bhqd,bhkd->bhqk", g32, v32)
     delta = (g32 * out.astype(jnp.float32)).sum(-1, keepdims=True)
+    if glse is not None:
+        delta = delta - glse[..., None]
     ds = p * (dp - delta)
     dq = jnp.einsum("bhqk,bhkd->bhqd", ds, k.astype(jnp.float32)) * scale
     dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q.astype(jnp.float32)) * scale
@@ -489,7 +537,41 @@ def _fa_bwd(causal, sm_scale, block_q, block_k, res, g):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
+def _fa_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+    (out, _), res = _fa_fwd_lse(q, k, v, causal, sm_scale, block_q, block_k)
+    return out, res
+
+
+def _fa_bwd(causal, sm_scale, block_q, block_k, res, g):
+    return _fa_bwd_impl(causal, sm_scale, block_q, block_k, res, g, None)
+
+
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_with_lse(q, k, v, causal: bool = True,
+                             sm_scale: Optional[float] = None,
+                             block_q: int = 256, block_k: int = 512):
+    """Like `flash_attention` but also returns lse (b, h, sq) f32 — the
+    building block for ring/blockwise attention where partial results over
+    disjoint key sets merge by logsumexp weights.  Differentiable in both
+    outputs (the lse cotangent folds into the delta term)."""
+    (out, lse), _ = _fa_fwd_lse(q, k, v, causal, sm_scale, block_q, block_k)
+    return out, lse
+
+
+def _fa_lse_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+    return _fa_fwd_lse(q, k, v, causal, sm_scale, block_q, block_k)
+
+
+def _fa_lse_bwd(causal, sm_scale, block_q, block_k, res, gs):
+    g, glse = gs
+    return _fa_bwd_impl(causal, sm_scale, block_q, block_k, res, g,
+                        glse.astype(jnp.float32))
+
+
+flash_attention_with_lse.defvjp(_fa_lse_fwd, _fa_lse_bwd)
 
 
 def mha(q, k, v, causal: bool = True, sm_scale: Optional[float] = None):

@@ -111,7 +111,18 @@ def auto_plan(num_devices: int, num_params: Optional[int] = None,
     plan.validate(num_devices)
     if num_params:
         # enforce the fit: state must shard across enough devices.  sp/ep
-        # don't shard the optimizer state, so only tp*fsdp counts.
+        # don't shard the optimizer state, so only tp*fsdp counts.  Before
+        # giving up, reclaim sp/ep devices for fsdp — fitting beats the
+        # nice-to-have axes.
+        while plan.tp * plan.fsdp < min_shards and (plan.sp > 1
+                                                    or plan.ep > 1):
+            if plan.sp > 1:
+                plan.sp //= 2
+            else:
+                plan.ep //= 2
+            plan.fsdp *= 2
+            logger.info("reclaimed a device axis for state fit: %s",
+                        plan.describe())
         if plan.tp * plan.fsdp < min_shards:
             raise ValueError(
                 f"model state (~{num_params * 14 / 1e9:.0f} GB) does not fit: "
