@@ -1,0 +1,14 @@
+"""Elastic data pipeline (parity: reference trainer/elastic + atorch/data)."""
+
+from .elastic_dataset import (
+    DevicePrefetcher,
+    ElasticDataLoader,
+    ElasticDataset,
+    ElasticDistributedSampler,
+    batch_iterator,
+)
+
+__all__ = [
+    "DevicePrefetcher", "ElasticDataLoader", "ElasticDataset",
+    "ElasticDistributedSampler", "batch_iterator",
+]

@@ -1,0 +1,253 @@
+"""Elastic data pipeline: dynamic-sharded dataset, resume-aware sampler,
+host→device prefetch.
+
+Parity: reference `dlrover/trainer/torch/elastic/sampler.py`
+(ElasticDistributedSampler :25, state_dict :118, load_state_dict :130),
+`elastic/dataloader.py` (ElasticDataLoader :26), atorch
+`data/elastic_dataset.py` (ElasticDataset :19) and `data/preloader.py`
+(GpuPreLoader :8).
+
+TPU redesign: a JAX input pipeline is host-side numpy; the "loader" is an
+iterator of pytrees the training loop `device_put`s with the mesh's batch
+sharding.  Elasticity comes from (a) the master-backed `ShardingClient`
+(workers pull shards, failed workers' shards are re-dispatched — the dynamic
+path) or (b) the deterministic `ElasticDistributedSampler` (rank-sliced with
+a resumable epoch/step cursor — the static path).  `DevicePrefetcher`
+overlaps host batch prep with device compute.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from ..common.log import get_logger
+
+logger = get_logger("data")
+
+
+class ElasticDistributedSampler:
+    """Deterministic rank-sliced sampler with a resumable position.
+
+    Parity: reference sampler.py:25 — `state_dict`/`load_state_dict` let a
+    restarted (possibly re-scaled) job continue mid-epoch: `completed_num`
+    counts globally-consumed samples; on resume each new rank continues from
+    that global offset regardless of the new world size.
+    """
+
+    def __init__(self, dataset_size: int, num_replicas: int = 1,
+                 rank: int = 0, shuffle: bool = True, seed: int = 0,
+                 drop_last: bool = False):
+        if rank >= num_replicas:
+            raise ValueError(f"rank {rank} >= num_replicas {num_replicas}")
+        self.dataset_size = dataset_size
+        self.num_replicas = num_replicas
+        self.rank = rank
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+        self.completed_num = 0  # global samples consumed in this epoch
+
+    def _epoch_indices(self) -> np.ndarray:
+        idx = np.arange(self.dataset_size)
+        if self.shuffle:
+            rng = np.random.RandomState(self.seed + self.epoch)
+            rng.shuffle(idx)
+        if self.drop_last:
+            total = (len(idx) // self.num_replicas) * self.num_replicas
+            idx = idx[:total]
+        elif len(idx) % self.num_replicas:
+            # pad (wrap around) so every rank yields the same count — in SPMD
+            # every process must drive the same number of collective steps or
+            # the job hangs at epoch end (torch DistributedSampler contract)
+            pad = self.num_replicas - len(idx) % self.num_replicas
+            idx = np.concatenate([idx, idx[:pad]])
+        return idx
+
+    def __iter__(self) -> Iterator[int]:
+        idx = self._epoch_indices()
+        # skip what the job already consumed before the restart
+        start = self.completed_num
+        for i in range(start + self.rank, len(idx), self.num_replicas):
+            self.completed_num = min(i + self.num_replicas, len(idx))
+            yield int(idx[i])
+        self.epoch += 1
+        self.completed_num = 0
+
+    def __len__(self) -> int:
+        remaining = self.dataset_size - self.completed_num
+        return max(0, remaining) // self.num_replicas
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+        self.completed_num = 0
+
+    def state_dict(self) -> Dict:
+        """Parity sampler.py:118."""
+        return {"epoch": self.epoch, "completed_num": self.completed_num}
+
+    def load_state_dict(self, state: Dict):
+        """Parity sampler.py:130 — tolerant of a changed world size."""
+        self.epoch = int(state.get("epoch", 0))
+        self.completed_num = int(state.get("completed_num", 0))
+        # align to the new replica grid so ranks don't overlap
+        self.completed_num -= self.completed_num % self.num_replicas
+
+
+class ElasticDataset:
+    """Master-sharded dataset: indices stream from the dynamic-sharding
+    service, so a failed worker's in-flight shards are re-dispatched.
+
+    Parity: atorch `data/elastic_dataset.py:19` (built on the reference's
+    IndexShardingClient).
+    """
+
+    def __init__(self, sharding_client, read_sample: Callable[[int], Any]):
+        self._client = sharding_client
+        self._read = read_sample
+
+    def __iter__(self) -> Iterator[Any]:
+        while True:
+            index = self._client.fetch_sample_index()
+            if index is None:
+                return
+            yield self._read(index)
+
+    def report_batch_done(self, n: int):
+        self._client.report_batch_done(n)
+
+
+def batch_iterator(sample_iter: Iterator[Any], batch_size: int,
+                   collate: Optional[Callable[[List[Any]], Any]] = None,
+                   drop_last: bool = True) -> Iterator[Any]:
+    """Group samples into batches; default collate stacks numpy leaves."""
+    collate = collate or _default_collate
+    buf: List[Any] = []
+    for s in sample_iter:
+        buf.append(s)
+        if len(buf) == batch_size:
+            yield collate(buf)
+            buf = []
+    if buf and not drop_last:
+        yield collate(buf)
+
+
+def _default_collate(samples: List[Any]):
+    import jax
+
+    return jax.tree.map(lambda *xs: np.stack(xs), *samples)
+
+
+class DevicePrefetcher:
+    """Overlap host batch prep (+ device transfer) with compute.
+
+    Parity: atorch `data/preloader.py:8` (GpuPreLoader — CUDA-stream
+    prefetch).  TPU version: a background thread runs `place` (typically
+    `AccelerateResult.place_batch`) so the next batch's host→HBM copy
+    overlaps the current step.
+    """
+
+    def __init__(self, it: Iterator[Any], place: Callable[[Any], Any],
+                 depth: int = 2):
+        import queue as _q
+
+        self._q: "_q.Queue" = _q.Queue(maxsize=depth)
+        self._src = it
+        self._place = place
+        self._done = object()
+        self._err: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        try:
+            for batch in self._src:
+                self._q.put(self._place(batch))
+        except BaseException as e:  # noqa: BLE001 — surfaced on next()
+            self._err = e
+        finally:
+            self._q.put(self._done)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is self._done:
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item
+
+
+class ElasticDataLoader:
+    """Batched loader over either sampler- or master-sharded indices, with
+    a master-tunable batch size.
+
+    Parity: reference `elastic/dataloader.py:26` (`update_batch_size :133` —
+    the master's paral-config tuner can adjust the local batch size).
+    """
+
+    def __init__(self, read_sample: Callable[[int], Any],
+                 batch_size: int,
+                 sampler: Optional[ElasticDistributedSampler] = None,
+                 sharding_client=None,
+                 collate: Optional[Callable] = None,
+                 drop_last: bool = True,
+                 with_state: bool = False):
+        """`with_state=True` yields `(batch, sampler_state)` pairs where the
+        state snapshot is taken when the batch is BUILT — checkpoint that
+        state, not `sampler.state_dict()` directly: a `DevicePrefetcher`
+        advances the sampler ahead of consumption, so the live sampler
+        position skips prefetched-but-unconsumed samples after a restore."""
+        if (sampler is None) == (sharding_client is None):
+            raise ValueError("exactly one of sampler/sharding_client")
+        self._read = read_sample
+        self.batch_size = batch_size
+        self._sampler = sampler
+        self._client = sharding_client
+        self._collate = collate
+        self._drop_last = drop_last
+        self._with_state = with_state
+
+    def update_batch_size(self, batch_size: int):
+        """Takes effect on the NEXT batch, including mid-epoch (the master's
+        paral-config tuner adjusts this during training)."""
+        logger.info("dataloader batch size %d -> %d", self.batch_size,
+                    batch_size)
+        self.batch_size = batch_size
+
+    def _samples(self) -> Iterator[Any]:
+        if self._sampler is not None:
+            return (self._read(i) for i in self._sampler)
+        return iter(ElasticDataset(self._client, self._read))
+
+    def __iter__(self) -> Iterator[Any]:
+        samples = self._samples()
+        collate = self._collate or _default_collate
+        buf: List[Any] = []
+        for s in samples:
+            buf.append(s)
+            if len(buf) < self.batch_size:  # re-read: tunable mid-epoch
+                continue
+            n = len(buf)
+            yield self._emit(collate(buf))
+            buf = []
+            # generator resumed → the consumer moved past the batch: report
+            # its SAMPLE count consumed (the sharding client counts samples
+            # toward shard completion; at-least-once — a crash mid-batch
+            # leaves the shard unfinished and it gets re-dispatched)
+            if self._client is not None:
+                self._client.report_batch_done(n)
+        if buf and not self._drop_last:
+            yield self._emit(collate(buf))
+            if self._client is not None:
+                self._client.report_batch_done(len(buf))
+
+    def _emit(self, batch):
+        if self._with_state and self._sampler is not None:
+            return batch, self._sampler.state_dict()
+        return batch

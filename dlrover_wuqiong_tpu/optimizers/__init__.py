@@ -1,0 +1,16 @@
+"""Optimizers: AGD (NeurIPS'23), WeightedSAM (KDD'23), low-bit Adam states.
+
+Parity: reference `atorch/atorch/optimizers/` (agd.py, wsam.py, low_bit/).
+"""
+
+from .agd import agd, scale_by_agd
+from .low_bit import adamw8bit, dequantize_blockwise, quantize_blockwise, \
+    scale_by_adam8bit
+from .wsam import make_wsam_train_step, wsam_gradients
+
+__all__ = [
+    "agd", "scale_by_agd",
+    "adamw8bit", "scale_by_adam8bit",
+    "quantize_blockwise", "dequantize_blockwise",
+    "make_wsam_train_step", "wsam_gradients",
+]

@@ -1,0 +1,92 @@
+"""AGD optimizer (NeurIPS'23) in optax idiom.
+
+Parity: reference `atorch/atorch/optimizers/agd.py:18` — an auto-switchable
+optimizer preconditioning with the stepwise *gradient difference* of the
+bias-corrected first moment.  The reference reports up to 1.5x faster
+convergence than AdamW on nanoGPT (atorch/docs/README-AGD.md:29).
+
+Math (per step t, decoupled weight decay handled by the enclosing chain):
+    m_t   = b1 m_{t-1} + (1-b1) g_t
+    d_t   = m_t / (1-b1^t) - m_{t-1} / (1-b1^{t-1})     (d_1 = m_1/(1-b1))
+    v_t   = b2 v_{t-1} + (1-b2) d_t^2
+    den   = max(sqrt(v_t), delta * sqrt(1-b2^t))        (amsgrad: running max)
+    u_t   = clip(m_t / den) * sqrt(1-b2^t) / (1-b1^t)
+    w_t   = w_{t-1} (1 - lr wd) - lr u_t
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class ScaleByAgdState(NamedTuple):
+    count: jax.Array
+    mu: optax.Updates
+    nu: optax.Updates
+    max_nu: Optional[optax.Updates]
+
+
+def scale_by_agd(b1: float = 0.9, b2: float = 0.999, delta: float = 1e-5,
+                 amsgrad: bool = False,
+                 clip: Optional[float] = None) -> optax.GradientTransformation:
+    def init_fn(params):
+        zeros = jax.tree.map(lambda p: jnp.zeros_like(p, jnp.float32), params)
+        return ScaleByAgdState(
+            count=jnp.zeros((), jnp.int32), mu=zeros,
+            nu=jax.tree.map(jnp.zeros_like, zeros),
+            max_nu=jax.tree.map(jnp.zeros_like, zeros) if amsgrad else None)
+
+    def update_fn(updates, state, params=None):
+        del params
+        t = state.count + 1
+        tf = t.astype(jnp.float32)
+        bc1 = 1.0 - b1 ** tf
+        bc1_old = 1.0 - b1 ** (tf - 1.0)
+        bc1_old_safe = jnp.where(t > 1, bc1_old, 1.0)
+        bc2 = 1.0 - b2 ** tf
+
+        mu = jax.tree.map(
+            lambda m, g: b1 * m + (1 - b1) * g.astype(jnp.float32),
+            state.mu, updates)
+        diff = jax.tree.map(
+            lambda m_new, m_old: m_new / bc1 - jnp.where(
+                t > 1, m_old / bc1_old_safe, 0.0),
+            mu, state.mu)
+        nu = jax.tree.map(lambda v, d: b2 * v + (1 - b2) * d * d,
+                          state.nu, diff)
+        if amsgrad:
+            max_nu = jax.tree.map(jnp.maximum, state.max_nu, nu)
+            den_src = max_nu
+        else:
+            max_nu = None
+            den_src = nu
+
+        floor = delta * jnp.sqrt(bc2)
+
+        def _u(m, v):
+            u = m / jnp.maximum(jnp.sqrt(v), floor)
+            if clip is not None:
+                u = jnp.clip(u, -clip, clip)
+            return u * jnp.sqrt(bc2) / bc1
+
+        out = jax.tree.map(_u, mu, den_src)
+        return out, ScaleByAgdState(count=t, mu=mu, nu=nu, max_nu=max_nu)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def agd(learning_rate: float | optax.Schedule = 1e-3,
+        betas: Tuple[float, float] = (0.9, 0.999), delta: float = 1e-5,
+        weight_decay: float = 0.0, amsgrad: bool = False,
+        clip: Optional[float] = None) -> optax.GradientTransformation:
+    """AGD with decoupled weight decay (reference `weight_decouple=True`)."""
+    return optax.chain(
+        scale_by_agd(betas[0], betas[1], delta, amsgrad, clip),
+        optax.add_decayed_weights(weight_decay) if weight_decay
+        else optax.identity(),
+        optax.scale_by_learning_rate(learning_rate),
+    )

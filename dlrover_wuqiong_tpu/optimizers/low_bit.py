@@ -1,0 +1,123 @@
+"""Low-bit optimizer states: block-wise int8 Adam moments.
+
+Parity: reference `atorch/atorch/optimizers/low_bit/` (4/8-bit optimizer
+states backed by triton/CUDA quant kernels `atorch/ops/csrc/quantize.cu`,
+`quantization_optimizer.cu`).
+
+TPU redesign: the quantize/dequantize are plain jnp — blockwise absmax int8
+with an f32 scale per block — and XLA fuses them into the surrounding
+elementwise update, so no custom kernel is needed for the memory win: mu/nu
+are stored int8 (+ 1/256 f32 scales), cutting Adam state from 8 to ~2.03
+bytes/param.  Numerics: absmax blockwise quantization, deterministic
+rounding; bias-corrected Adam update in f32.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+BLOCK = 256
+
+
+@jax.tree_util.register_pytree_node_class
+class QTensor:
+    """Blockwise-int8 tensor: int8 payload + per-block f32 absmax scale."""
+
+    def __init__(self, q, scale, size: int, shape: Tuple[int, ...]):
+        self.q = q
+        self.scale = scale
+        self.size = size
+        self.shape = shape
+
+    def tree_flatten(self):
+        return (self.q, self.scale), (self.size, self.shape)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux[0], aux[1])
+
+
+def quantize_blockwise(x: jax.Array) -> QTensor:
+    """Nonlinear (quadratic-map) signed int8: code = 127*sqrt(|x|/absmax).
+
+    A linear absmax map starves small elements sharing a block with a large
+    one (codes round to 0 and the moment dies); the sqrt code map gives
+    ~relative precision near zero — the same reason the reference's CUDA
+    kernels use a nonlinear dynamic map (quantize.cu)."""
+    flat = x.astype(jnp.float32).reshape(-1)
+    n = flat.size
+    pad = (-n) % BLOCK
+    flat = jnp.pad(flat, (0, pad)).reshape(-1, BLOCK)
+    absmax = jnp.max(jnp.abs(flat), axis=1, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax, 1.0)
+    norm = jnp.sqrt(jnp.abs(flat) / scale)
+    q = (jnp.sign(flat) * jnp.clip(jnp.round(norm * 127.0), 0, 127)
+         ).astype(jnp.int8)
+    return QTensor(q=q, scale=scale[:, 0], size=n, shape=tuple(x.shape))
+
+
+def dequantize_blockwise(qv: QTensor) -> jax.Array:
+    c = qv.q.astype(jnp.float32) / 127.0
+    flat = jnp.sign(c) * c * c * qv.scale[:, None]
+    return flat.reshape(-1)[:qv.size].reshape(qv.shape)
+
+
+class ScaleByAdam8bitState(NamedTuple):
+    count: jax.Array
+    mu: optax.Updates   # tree of QTensor
+    nu: optax.Updates   # tree of QTensor
+
+
+def scale_by_adam8bit(b1: float = 0.9, b2: float = 0.999,
+                      eps: float = 1e-8) -> optax.GradientTransformation:
+    _is_q = lambda x: isinstance(x, QTensor)  # noqa: E731
+
+    def init_fn(params):
+        qzero = lambda p: quantize_blockwise(  # noqa: E731
+            jnp.zeros(p.shape, jnp.float32))
+        return ScaleByAdam8bitState(
+            count=jnp.zeros((), jnp.int32),
+            mu=jax.tree.map(qzero, params),
+            nu=jax.tree.map(qzero, params))
+
+    def update_fn(updates, state, params=None):
+        del params
+        t = state.count + 1
+        tf = t.astype(jnp.float32)
+        bc1 = 1.0 - b1 ** tf
+        bc2 = 1.0 - b2 ** tf
+
+        flat_g, treedef = jax.tree.flatten(updates)
+        flat_mu = jax.tree.leaves(state.mu, is_leaf=_is_q)
+        flat_nu = jax.tree.leaves(state.nu, is_leaf=_is_q)
+        us, mus, nus = [], [], []
+        for g, mq, nq in zip(flat_g, flat_mu, flat_nu):
+            g = g.astype(jnp.float32)
+            m = b1 * dequantize_blockwise(mq) + (1 - b1) * g
+            v = b2 * dequantize_blockwise(nq) + (1 - b2) * g * g
+            us.append((m / bc1) / (jnp.sqrt(v / bc2) + eps))
+            mus.append(quantize_blockwise(m))
+            nus.append(quantize_blockwise(v))
+        return (jax.tree.unflatten(treedef, us),
+                ScaleByAdam8bitState(count=t,
+                                     mu=jax.tree.unflatten(treedef, mus),
+                                     nu=jax.tree.unflatten(treedef, nus)))
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def adamw8bit(learning_rate: float | optax.Schedule = 1e-3, b1: float = 0.9,
+              b2: float = 0.999, eps: float = 1e-8,
+              weight_decay: float = 0.0) -> optax.GradientTransformation:
+    """AdamW with int8 blockwise moments (~2 bytes/param of optimizer state
+    instead of 8)."""
+    return optax.chain(
+        scale_by_adam8bit(b1, b2, eps),
+        optax.add_decayed_weights(weight_decay) if weight_decay
+        else optax.identity(),
+        optax.scale_by_learning_rate(learning_rate),
+    )

@@ -55,29 +55,15 @@ except ImportError:  # pragma: no cover
 
 
 def _attention_with_lse(q, k, v, causal: bool, sm_scale: Optional[float]):
-    """(b, h, sq, d) attention returning (o, lse) — jnp path usable anywhere.
-
-    lse: (b, h, sq) f32 logsumexp of the (scaled) scores; rows with no
-    visible keys get lse=-inf and o=0.
-    """
+    """(b, h, sq, d) attention returning (o, lse (b, h, sq) f32) — jnp path
+    usable on any backend (shared with ops.flash_attention's fallback)."""
     import math
+
+    from ..ops.flash_attention import _reference_with_lse
 
     d = q.shape[-1]
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
-    if causal:
-        sq, sk = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
-        s = jnp.where(mask, s, -jnp.inf)
-    m = jax.lax.stop_gradient(jnp.max(s, axis=-1, keepdims=True))
-    m = jnp.where(jnp.isfinite(m), m, 0.0)
-    p = jnp.exp(s - m)
-    l = p.sum(axis=-1, keepdims=True)
-    lse = jnp.where(l > 0, m + jnp.log(jnp.where(l > 0, l, 1.0)),
-                    -jnp.inf)[..., 0]
-    o = jnp.einsum("bhqk,bhkd->bhqd", (p / jnp.where(l > 0, l, 1.0)).astype(
-        v.dtype), v)
-    return o, lse
+    return _reference_with_lse(q, k, v, causal, scale)
 
 
 def _merge_partials(o1, lse1, o2, lse2):
