@@ -32,6 +32,10 @@ class GPTConfig:
     use_flash_attention: bool = True
     attn_impl: str = "flash"  # "flash" | "ring" | "ulysses"
     mesh: Any = None  # required by ring/ulysses (set by auto_accelerate)
+    # MoE: 0 experts = dense MLP (parity atorch modules/moe)
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     @classmethod
     def nano(cls):  # tiny config for tests
@@ -118,8 +122,18 @@ class Block(nn.Module):
         cfg = self.config
         x = x + CausalSelfAttention(cfg, name="attn")(
             nn.LayerNorm(dtype=cfg.dtype, name="ln_1")(x), deterministic)
-        x = x + MLP(cfg, name="mlp")(
-            nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x), deterministic)
+        if cfg.moe_experts:
+            from .moe import MoEConfig, MoEMLP
+
+            mlp = MoEMLP(cfg.n_embd, 4 * cfg.n_embd,
+                         MoEConfig(num_experts=cfg.moe_experts,
+                                   top_k=cfg.moe_top_k,
+                                   capacity_factor=cfg.moe_capacity_factor,
+                                   dtype=cfg.dtype), name="moe_mlp")
+            x = x + mlp(nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x))
+        else:
+            x = x + MLP(cfg, name="mlp")(
+                nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x), deterministic)
         return x
 
 

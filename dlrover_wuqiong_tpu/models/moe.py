@@ -1,0 +1,140 @@
+"""Mixture-of-Experts layer with expert parallelism, TPU-first.
+
+Parity: reference `atorch/atorch/modules/moe/` — `MOELayer`/`Experts`
+(moe_layer.py:29-116, `_AllToAll` :87), `topk_gating.py`, `switch_gating.py`,
+`grouped_gemm_moe.py`.
+
+TPU redesign: experts live as a stacked (E, d_in, d_out) parameter sharded
+P("ep", ...) on the mesh.  Routing is dense capacity-based dispatch — a
+one-hot combine tensor contracted with einsum, the canonical XLA MoE shape
+(Switch/GShard style): no ragged host loops, everything static for the MXU.
+GSPMD inserts the all-to-alls from the shardings; an explicit shard_map
+dispatch is unnecessary on TPU, which is exactly the "GSPMD over hand-written
+collectives" design stance (SURVEY.md §7).
+
+Load-balancing aux loss follows Switch Transformer (mean fraction * mean
+router prob per expert, scaled by E^2).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+    dtype: Any = jnp.bfloat16
+
+
+def top_k_gating(logits: jax.Array, k: int, capacity: int,
+                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Returns (combine (T, E, C), dispatch bool (T, E, C), aux_loss).
+
+    T tokens, E experts, C capacity per expert.  Tokens beyond an expert's
+    capacity are dropped (standard GShard semantics).
+    Parity: reference topk_gating.py / switch_gating.py.
+    """
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    # iteratively pick top-k experts per token, masking chosen ones
+    combine = jnp.zeros((T, E, capacity), jnp.float32)
+    dispatch = jnp.zeros((T, E, capacity), bool)
+    masked = probs
+    # position counters are computed per expert over the token axis
+    fill = jnp.zeros((E,), jnp.int32)
+    for _ in range(k):
+        choice = jnp.argmax(masked, axis=-1)                    # (T,)
+        onehot = jax.nn.one_hot(choice, E, dtype=jnp.int32)     # (T, E)
+        # position of each token within its chosen expert's queue
+        pos = (jnp.cumsum(onehot, axis=0) - 1) + fill[None, :]  # (T, E)
+        fill = fill + onehot.sum(axis=0)
+        pos_tok = jnp.take_along_axis(pos, choice[:, None],
+                                      axis=1)[:, 0]             # (T,)
+        keep = pos_tok < capacity
+        gate = jnp.take_along_axis(probs, choice[:, None], axis=1)[:, 0]
+        pos_oh = jax.nn.one_hot(jnp.where(keep, pos_tok, capacity),
+                                capacity, dtype=jnp.float32)    # (T, C)
+        contrib = (onehot.astype(jnp.float32) * gate[:, None]
+                   )[:, :, None] * pos_oh[:, None, :]
+        combine = combine + jnp.where(keep[:, None, None], contrib, 0.0)
+        dispatch = dispatch | (jnp.where(keep[:, None, None], contrib, 0.0)
+                               > 0)
+        masked = masked * (1.0 - onehot.astype(jnp.float32))
+
+    # Switch-style load balance loss on the top-1 assignment distribution
+    top1 = jax.nn.one_hot(jnp.argmax(probs, -1), E, dtype=jnp.float32)
+    frac_tokens = top1.mean(axis=0)
+    frac_probs = probs.mean(axis=0)
+    aux = (frac_tokens * frac_probs).sum() * (E * E)
+
+    # renormalize combine weights over the selected experts (top-k > 1)
+    denom = combine.sum(axis=(1, 2), keepdims=True)
+    combine = combine / jnp.where(denom > 0, denom, 1.0)
+    return combine, dispatch, aux
+
+
+class MoEMLP(nn.Module):
+    """Drop-in MLP replacement: router + E stacked SwiGLU/GELU experts.
+
+    Expert weights are (E, d, h)/(E, h, d) so the `ep` mesh axis shards the
+    leading dim (MOE_RULES in parallel/sharding.py); dispatch/combine einsums
+    let GSPMD place the all-to-alls on ICI.
+    """
+
+    hidden: int
+    ffn: int
+    moe: MoEConfig
+
+    @nn.compact
+    def __call__(self, x):  # x: (B, T, d)
+        cfg = self.moe
+        B, T, d = x.shape
+        tokens = x.reshape(B * T, d)
+        n_tok = B * T
+        capacity = max(1, int(cfg.capacity_factor * n_tok * cfg.top_k
+                              / cfg.num_experts))
+
+        router = nn.Dense(cfg.num_experts, use_bias=False,
+                          dtype=jnp.float32, name="router")
+        logits = router(tokens.astype(jnp.float32))
+        combine, dispatch, aux = top_k_gating(logits, cfg.top_k, capacity)
+        self.sow("intermediates", "moe_aux_loss",
+                 aux * cfg.aux_loss_weight)
+
+        w_in = self.param(
+            "experts_w_in", nn.initializers.normal(0.02),
+            (cfg.num_experts, d, self.ffn)).astype(cfg.dtype)
+        w_gate = self.param(
+            "experts_w_gate", nn.initializers.normal(0.02),
+            (cfg.num_experts, d, self.ffn)).astype(cfg.dtype)
+        w_out = self.param(
+            "experts_w_down", nn.initializers.normal(0.02),
+            (cfg.num_experts, self.ffn, d)).astype(cfg.dtype)
+
+        # dispatch: (T, E, C) x (T, d) -> (E, C, d)
+        xe = jnp.einsum("tec,td->ecd", dispatch.astype(cfg.dtype),
+                        tokens.astype(cfg.dtype))
+        h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", xe, w_gate)) * \
+            jnp.einsum("ecd,edf->ecf", xe, w_in)
+        ye = jnp.einsum("ecf,efd->ecd", h, w_out)
+        # combine back: (T, E, C) x (E, C, d) -> (T, d)
+        out = jnp.einsum("tec,ecd->td", combine.astype(cfg.dtype), ye)
+        return out.reshape(B, T, d)
+
+
+def collect_moe_aux_loss(intermediates) -> jax.Array:
+    """Sum every sown `moe_aux_loss` in an intermediates collection."""
+    total = jnp.zeros((), jnp.float32)
+    for leaf in jax.tree.leaves(intermediates):
+        total = total + jnp.sum(leaf)
+    return total

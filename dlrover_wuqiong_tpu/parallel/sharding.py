@@ -64,8 +64,8 @@ TRANSFORMER_RULES: List[Rule] = [
 
 MOE_RULES: List[Rule] = [
     # expert weights: (num_experts, in, out) — experts over ep
-    (r".*experts.*(w1|w3|up|gate).*", P("ep", "fsdp", "tp")),
-    (r".*experts.*(w2|down).*", P("ep", "tp", "fsdp")),
+    (r".*experts.*(w_in|w_gate|w1|w3|up|gate).*", P("ep", "fsdp", "tp")),
+    (r".*experts.*(w_down|w2|down).*", P("ep", "tp", "fsdp")),
     (r".*(router|gate)/kernel$", P("fsdp", None)),
 ]
 

@@ -115,11 +115,21 @@ def shard_train_state(state: TrainState, planner: ShardingPlanner
 
 
 def make_lm_loss(model_apply: Callable) -> Callable:
-    """Standard causal-LM loss over a batch dict {input_ids, labels}."""
+    """Standard causal-LM loss over a batch dict {input_ids, labels}.
+
+    Collects sown auxiliary losses (MoE load-balancing) when present."""
     from ..models.gpt import cross_entropy_loss
 
     def loss_fn(params, batch):
-        logits = model_apply({"params": params}, batch["input_ids"])
-        return cross_entropy_loss(logits, batch["labels"])
+        logits, updates = model_apply(
+            {"params": params}, batch["input_ids"],
+            mutable=["intermediates"])
+        loss = cross_entropy_loss(logits, batch["labels"])
+        inter = updates.get("intermediates", {})
+        if inter:
+            from ..models.moe import collect_moe_aux_loss
+
+            loss = loss + collect_moe_aux_loss(inter)
+        return loss
 
     return loss_fn

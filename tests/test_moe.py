@@ -1,0 +1,104 @@
+"""MoE layer + expert parallelism tests on the virtual 8-device mesh.
+
+Mirrors the reference's moe tests (atorch modules/moe) translated to
+dense-dispatch GShard-style MoE under GSPMD.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dlrover_wuqiong_tpu.auto.accelerate import auto_accelerate
+from dlrover_wuqiong_tpu.models.gpt import GPT, GPTConfig
+from dlrover_wuqiong_tpu.models.moe import MoEConfig, MoEMLP, top_k_gating
+
+
+class TestTopKGating:
+    def test_top1_each_token_dispatched_once(self):
+        logits = jax.random.normal(jax.random.PRNGKey(0), (16, 4))
+        combine, dispatch, aux = top_k_gating(logits, k=1, capacity=16)
+        # every token lands in exactly one (expert, slot)
+        assert dispatch.sum() == 16
+        np.testing.assert_allclose(combine.sum(axis=(1, 2)),
+                                   np.ones(16), atol=1e-6)
+
+    def test_top2_combine_normalized(self):
+        logits = jax.random.normal(jax.random.PRNGKey(1), (32, 8))
+        combine, dispatch, aux = top_k_gating(logits, k=2, capacity=32)
+        assert int(dispatch.sum()) == 64  # 2 slots per token
+        np.testing.assert_allclose(combine.sum(axis=(1, 2)),
+                                   np.ones(32), atol=1e-6)
+
+    def test_capacity_drops_overflow(self):
+        # all tokens prefer expert 0; capacity 4 keeps only 4
+        logits = jnp.stack([jnp.full((16,), 5.0)] + [jnp.zeros(16)] * 3,
+                           axis=1)
+        combine, dispatch, aux = top_k_gating(logits, k=1, capacity=4)
+        assert int(dispatch[:, 0].sum()) == 4
+
+    def test_no_slot_collisions(self):
+        logits = jax.random.normal(jax.random.PRNGKey(2), (64, 4))
+        combine, dispatch, aux = top_k_gating(logits, k=2, capacity=64)
+        # each (expert, slot) holds at most one token
+        per_slot = dispatch.sum(axis=0)
+        assert int(per_slot.max()) <= 1
+
+    def test_aux_loss_penalizes_imbalance(self):
+        balanced = jnp.tile(jnp.eye(4), (4, 1)) * 4.0
+        skewed = jnp.stack([jnp.full((16,), 4.0)] + [jnp.zeros(16)] * 3,
+                           axis=1)
+        _, _, aux_b = top_k_gating(balanced, 1, 16)
+        _, _, aux_s = top_k_gating(skewed, 1, 16)
+        assert float(aux_s) > float(aux_b)
+
+
+class TestMoEMLP:
+    def test_forward_shape_and_aux(self):
+        layer = MoEMLP(hidden=32, ffn=64, moe=MoEConfig(
+            num_experts=4, top_k=2, dtype=jnp.float32))
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 32))
+        params = layer.init(jax.random.PRNGKey(1), x)
+        y, updates = layer.apply(params, x, mutable=["intermediates"])
+        assert y.shape == x.shape
+        assert "moe_aux_loss" in updates["intermediates"]
+
+
+class TestMoETraining:
+    def test_gpt_moe_trains_with_expert_parallelism(self):
+        cfg = GPTConfig(vocab_size=256, n_layer=2, n_head=2, n_embd=64,
+                        block_size=64, dtype=jnp.float32, moe_experts=4)
+        res = auto_accelerate(
+            GPT(cfg), optimizer=optax.adamw(1e-2),
+            strategy=[("expert_parallel", {"size": 4}), ("fsdp", {})])
+        data = jax.random.randint(jax.random.PRNGKey(0), (8, 65), 0, 256)
+        batch = res.place_batch({"input_ids": data[:, :-1],
+                                 "labels": data[:, 1:]})
+        state, losses = res.state, []
+        for _ in range(8):
+            state, m = res.train_step(state, batch)
+            losses.append(float(m["loss"]))
+        assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+    def test_expert_weights_sharded_over_ep(self):
+        cfg = GPTConfig(vocab_size=256, n_layer=1, n_head=2, n_embd=64,
+                        block_size=64, dtype=jnp.float32, moe_experts=4)
+        res = auto_accelerate(
+            GPT(cfg), optimizer=optax.adamw(1e-2),
+            strategy=[("expert_parallel", {"size": 4}), ("fsdp", {})])
+        w = res.state.params["h_0"]["moe_mlp"]["experts_w_in"]
+        # 4 experts over ep=4 (x fsdp=2): expert dim must be split
+        idx = {s.index[0] for s in w.addressable_shards}
+        assert len(idx) == 4
+
+    def test_moe_matches_dense_param_count_scaling(self):
+        dense = GPTConfig(vocab_size=256, n_layer=1, n_head=2, n_embd=64,
+                          block_size=64)
+        moe = GPTConfig(vocab_size=256, n_layer=1, n_head=2, n_embd=64,
+                        block_size=64, moe_experts=4)
+        pd = GPT(dense).init_params(jax.random.PRNGKey(0))
+        pm = GPT(moe).init_params(jax.random.PRNGKey(0))
+        nd = sum(x.size for x in jax.tree.leaves(pd))
+        nm = sum(x.size for x in jax.tree.leaves(pm))
+        assert nm > nd  # experts multiply MLP params
