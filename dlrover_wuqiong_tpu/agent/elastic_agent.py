@@ -225,6 +225,17 @@ class ElasticAgent:
                               accelerator_num=self.config.nproc_per_node)
         while not self._stopped.is_set():
             outcome = self.rendezvous()
+            if self._saver is not None:
+                # commit must wait for EVERY rank's done-file — tell the saver
+                # the current world size (reference ckpt_saver.py:863).  Ranks
+                # are re-assigned each rendezvous (compacted on scale-down),
+                # so the saver's committer/global-rank identity must follow.
+                # Routed through the event queue: applies on the saver thread,
+                # never racing an in-flight save.
+                from ..checkpoint.ckpt_saver import CheckpointEvent
+
+                self._saver._event_queue.put(CheckpointEvent.update_world(
+                    outcome.num_processes, outcome.process_id))
             self._worker = self._launch_worker(outcome)
             exit_code = self._monitor_worker()
             if exit_code == 0:

@@ -112,6 +112,7 @@ def _s_ep(ctx: StrategyContext, cfg: Dict, num_devices: int):
 @register_strategy("pipeline_parallel")
 def _s_pp(ctx: StrategyContext, cfg: Dict, num_devices: int):
     ctx.plan.pp = cfg.get("size", 1)
+    ctx.extra["pp_microbatches"] = cfg.get("microbatches")
 
 
 @register_strategy("amp_native")
@@ -260,6 +261,34 @@ def auto_accelerate(
             else type(model)(new_cfg)
         logger.info("sequence parallel: %s attention over sp=%d", sp_impl,
                     ctx.plan.sp)
+
+    if ctx.plan.pp > 1:
+        # stage-sliced GPipe pipeline over the pp axis (parallel/pipeline.py)
+        from ..parallel.pipeline import PipelinedLM, PipelineShardingPlanner
+
+        if ctx.plan.sp > 1 and sp_impl != "gspmd":
+            raise ValueError(
+                "pipeline_parallel does not compose with ring/ulysses "
+                "sequence parallel yet — use impl='gspmd' or drop one")
+        if getattr(model.config, "moe_experts", 0):
+            # PipelinedLM.apply drops sown intermediates, which would
+            # silently lose the MoE load-balancing aux loss
+            raise ValueError(
+                "pipeline_parallel does not support MoE models yet "
+                "(the router aux loss cannot flow out of the pipeline)")
+        n_layer = getattr(model.config, "n_layer",
+                          getattr(model.config, "num_layers", None))
+        if n_layer is None or n_layer % ctx.plan.pp:
+            raise ValueError(
+                f"pipeline_parallel needs layers ({n_layer}) divisible by "
+                f"pp={ctx.plan.pp}")
+        microbatches = ctx.extra.get("pp_microbatches") or max(
+            ctx.accum_steps, 2 * ctx.plan.pp)
+        model = PipelinedLM(model, mesh, microbatches)
+        planner = PipelineShardingPlanner(planner)
+        logger.info("pipeline parallel: %d stages x %d layers, %d "
+                    "microbatches", ctx.plan.pp, n_layer // ctx.plan.pp,
+                    microbatches)
 
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     params = model.init_params(rng)

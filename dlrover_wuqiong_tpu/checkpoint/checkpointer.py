@@ -64,6 +64,10 @@ class FlashCheckpointer:
     def last_step(self) -> int:
         return self.engine.latest_step()
 
+    def wait_staging(self, timeout: float = None):
+        """Block until the in-flight async staging (if any) completes."""
+        self.engine.wait_staging(timeout)
+
     def wait_latest_checkpoint(self, timeout: float = 600.0) -> bool:
         return self.engine.wait_saving_latest(timeout)
 

@@ -29,7 +29,7 @@ from typing import Dict, List, Optional
 
 from ..common.constants import CheckpointConstant
 from ..common.log import get_logger
-from ..common.multi_process import SharedQueue
+from ..common.multi_process import SharedLock, SharedQueue
 from ..common.storage import CheckpointStorage, get_checkpoint_storage
 from .shm_handler import SharedMemoryHandler
 
@@ -37,7 +37,14 @@ logger = get_logger("ckpt_saver")
 
 _SAVE_EVENT = "save"
 _UPDATE_SHARDS_EVENT = "update_shards"
+_UPDATE_WORLD_EVENT = "update_world"
 _EXIT_EVENT = "exit"
+
+
+def shm_lock_name(job_name: str, local_rank: int) -> str:
+    """Cross-process lock serializing shm staging (engine drain thread)
+    against shm→disk streaming (saver) for one segment."""
+    return f"{job_name}-ckpt-shm-{local_rank}"
 
 
 def step_dir(path: str, step: int) -> str:
@@ -50,8 +57,16 @@ class CheckpointEvent:
         return {"type": _SAVE_EVENT, "step": step, "path": path}
 
     @staticmethod
-    def update_shards(num: int) -> Dict:
-        return {"type": _UPDATE_SHARDS_EVENT, "num": num}
+    def update_shards(num: int, world_num: Optional[int] = None) -> Dict:
+        return {"type": _UPDATE_SHARDS_EVENT, "num": num,
+                "world_num": world_num}
+
+    @staticmethod
+    def update_world(world_num: int, node_rank: int) -> Dict:
+        """Re-rendezvous outcome: new world size + this node's new rank.
+        Routed through the event queue so it serializes with saves."""
+        return {"type": _UPDATE_WORLD_EVENT, "world_num": world_num,
+                "node_rank": node_rank}
 
     @staticmethod
     def exit() -> Dict:
@@ -66,20 +81,31 @@ class AsyncCheckpointSaver:
 
     def __init__(self, job_name: str = "dwt", local_shard_num: int = 1,
                  node_rank: int = 0,
-                 storage: Optional[CheckpointStorage] = None):
+                 storage: Optional[CheckpointStorage] = None,
+                 world_shard_num: Optional[int] = None):
         self.job_name = job_name
         self.node_rank = node_rank
         self.local_shard_num = local_shard_num
+        # total shards across ALL nodes — commit must wait for every rank's
+        # done-file, not just this node's (reference ckpt_saver.py:863)
+        self.world_shard_num = world_shard_num or local_shard_num
         self.storage = storage or get_checkpoint_storage()
         self._event_queue = SharedQueue(f"{job_name}-ckpt-events", master=True)
         self._shm_handlers: Dict[int, SharedMemoryHandler] = {
             r: SharedMemoryHandler(r, job_name)
             for r in range(local_shard_num)
         }
+        # per-segment writer/reader locks (master side lives here; training
+        # processes connect as clients via shm_lock_name)
+        self._shm_locks: Dict[int, SharedLock] = {
+            r: SharedLock(shm_lock_name(job_name, r), master=True)
+            for r in range(local_shard_num)
+        }
         self._executor = ThreadPoolExecutor(
             max_workers=max(4, local_shard_num), thread_name_prefix="ckpt-io")
         self._thread: Optional[threading.Thread] = None
         self._inflight: List = []  # shard-write futures of the current save
+        self._inflight_lock = threading.Lock()
         self._stopped = threading.Event()
         self._last_persisted_step = -1
         self._latest_shm_step = -1
@@ -91,13 +117,14 @@ class AsyncCheckpointSaver:
     def start_async_saving_ckpt(cls, job_name: str = "dwt",
                                 local_shard_num: int = 1,
                                 node_rank: int = 0,
-                                storage: Optional[CheckpointStorage] = None
+                                storage: Optional[CheckpointStorage] = None,
+                                world_shard_num: Optional[int] = None
                                 ) -> "AsyncCheckpointSaver":
         """Parity: reference ckpt_saver.py:410."""
         with cls._cls_lock:
             if cls._instance is None:
                 cls._instance = cls(job_name, local_shard_num, node_rank,
-                                    storage)
+                                    storage, world_shard_num)
                 cls._instance.start()
             return cls._instance
 
@@ -128,13 +155,23 @@ class AsyncCheckpointSaver:
         if self._thread is not None:
             self._thread.join(timeout=10)
         clean_exit = self._thread is None or not self._thread.is_alive()
-        if clean_exit and self._inflight:
+        with self._inflight_lock:
+            inflight = list(self._inflight)
+        if clean_exit and inflight:
             # bounded wait for in-flight shard writes (a hung storage backend
             # must not wedge agent teardown — mirror the thread-join bound)
             from concurrent.futures import wait as futures_wait
 
-            done, not_done = futures_wait(self._inflight, timeout=30)
+            done, not_done = futures_wait(inflight, timeout=30)
             clean_exit = not not_done
+        if clean_exit:
+            # a MEMORY-only checkpoint newer than the last persisted step
+            # would be lost with the segment — flush it first (reference
+            # save_shm_to_storage-on-teardown, ckpt_saver.py:634)
+            try:
+                self.save_shm_to_storage()
+            except Exception:  # noqa: BLE001
+                logger.exception("teardown flush of staged checkpoint failed")
         self._executor.shutdown(wait=False)
         for h in self._shm_handlers.values():
             h.close()
@@ -143,6 +180,8 @@ class AsyncCheckpointSaver:
                 # loop is wedged mid-save, keep it so the bytes survive for a
                 # post-mortem flush (the _ckpt_dir tag guards cross-job reuse).
                 h.unlink()
+        for lk in self._shm_locks.values():
+            lk.close()
         self._event_queue.close()
 
     def _sync_shm_to_storage(self):
@@ -156,7 +195,12 @@ class AsyncCheckpointSaver:
             if etype == _EXIT_EVENT:
                 return
             if etype == _UPDATE_SHARDS_EVENT:
-                self._update_shard_num(event["num"])
+                self._update_shard_num(event["num"], event.get("world_num"))
+                continue
+            if etype == _UPDATE_WORLD_EVENT:
+                # applied on this thread → never races an in-flight save
+                self.world_shard_num = event["world_num"]
+                self.node_rank = event["node_rank"]
                 continue
             if etype == _SAVE_EVENT:
                 try:
@@ -165,17 +209,27 @@ class AsyncCheckpointSaver:
                     logger.exception("async save of step %s failed",
                                      event.get("step"))
 
-    def _update_shard_num(self, num: int):
+    def _update_shard_num(self, num: int, world_num: Optional[int] = None):
         for h in self._shm_handlers.values():
             h.close()
+        for lk in self._shm_locks.values():
+            lk.close()
         self.local_shard_num = num
+        # without explicit world info, keep the known world size (never
+        # shrink to the local count — that re-opens the premature-commit bug)
+        self.world_shard_num = world_num or max(self.world_shard_num, num)
         self._shm_handlers = {
             r: SharedMemoryHandler(r, self.job_name) for r in range(num)
+        }
+        self._shm_locks = {
+            r: SharedLock(shm_lock_name(self.job_name, r), master=True)
+            for r in range(num)
         }
 
     # ------------------------------------------------------------------ save
 
-    def save_step_checkpoint(self, step: int, path: str):
+    def save_step_checkpoint(self, step: int, path: str,
+                             commit_timeout: Optional[float] = None):
         """Persist all local shards of `step` then commit."""
         start = time.time()
         sdir = step_dir(path, step)
@@ -185,11 +239,19 @@ class AsyncCheckpointSaver:
         for local_rank, handler in self._shm_handlers.items():
             futures.append(self._executor.submit(
                 self._save_shard, handler, step, sdir, local_rank))
-        self._inflight = futures
+        with self._inflight_lock:
+            self._inflight = futures
         ok = all(f.result() for f in futures)
-        self._inflight = []
+        with self._inflight_lock:
+            self._inflight = []
         if ok:
-            self.commit_checkpoint(step, path)
+            ok = self.commit_checkpoint(
+                step, path, expected_shards=self.world_shard_num,
+                timeout=commit_timeout or CheckpointConstant.SAVE_TIMEOUT)
+        if ok:
+            # only a committed step counts as persisted — a commit timeout
+            # (e.g. a peer never wrote its done-file) must leave the staged
+            # checkpoint eligible for the teardown/failure flush retry
             self._last_persisted_step = step
             self._latest_path = path
             logger.info("persisted checkpoint step=%d to %s in %.2fs", step,
@@ -199,7 +261,29 @@ class AsyncCheckpointSaver:
 
     def _save_shard(self, handler: SharedMemoryHandler, step: int,
                     sdir: str, local_rank: int) -> bool:
-        """Parity: reference `_save_shard` :544 — stream one shm segment."""
+        """Parity: reference `_save_shard` :544 — stream one shm segment.
+
+        Holds the segment's shared lock so a concurrent engine drain can't
+        overwrite the payload mid-stream (torn shard with a done-file)."""
+        lock = self._shm_locks.get(local_rank)
+        acquired = False
+        if lock is not None:
+            try:
+                acquired = lock.acquire(timeout=CheckpointConstant.
+                                        SAVE_TIMEOUT)
+            except Exception:  # noqa: BLE001 — degraded: stream unlocked
+                acquired = False
+        try:
+            return self._save_shard_locked(handler, step, sdir, local_rank)
+        finally:
+            if acquired:
+                try:
+                    lock.release()
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def _save_shard_locked(self, handler: SharedMemoryHandler, step: int,
+                           sdir: str, local_rank: int) -> bool:
         header = handler.load_header()
         if header is None:
             logger.warning("no shm data for local rank %d", local_rank)
@@ -241,14 +325,16 @@ class AsyncCheckpointSaver:
 
     def commit_checkpoint(self, step: int, path: str,
                           expected_shards: Optional[int] = None,
-                          timeout: float = CheckpointConstant.SAVE_TIMEOUT):
+                          timeout: float = CheckpointConstant.SAVE_TIMEOUT
+                          ) -> bool:
         """Write the tracker file once all ranks' done-files exist.
 
         Parity: reference `commit_checkpoint` :863 — rank-0 agent waits for
         done files of every shard then atomically publishes the step.
+        Returns False on timeout (step NOT published).
         """
         if self.node_rank != 0:
-            return
+            return True  # this node's shards are flushed; rank 0 publishes
         sdir = step_dir(path, step)
         done_dir = os.path.join(sdir, CheckpointConstant.DONE_DIR)
         expected = expected_shards or self.local_shard_num
@@ -259,10 +345,11 @@ class AsyncCheckpointSaver:
                                        CheckpointConstant.TRACKER_FILE)
                 self.storage.write(str(step), tracker)
                 self.storage.commit(step, True)
-                return
+                return True
             time.sleep(0.2)
         logger.error("commit timeout for step %d (%d/%d done)", step,
                      len(self.storage.listdir(done_dir)), expected)
+        return False
 
     # ------------------------------------------------------- failure handling
 
@@ -272,17 +359,21 @@ class AsyncCheckpointSaver:
         Parity: reference `save_shm_to_storage` :634.
         """
         steps = set()
+        tagged_dir = ""
         for handler in self._shm_handlers.values():
             header = handler.load_header()
             if header is not None:
                 steps.add(header.get("step"))
+                tagged_dir = (header.get("extra") or {}).get(
+                    "_ckpt_dir", tagged_dir)
         if not steps:
             return
         step = max(s for s in steps if s is not None)
-        if step <= self._last_persisted_step or not self._latest_path:
+        path = self._latest_path or tagged_dir
+        if step <= self._last_persisted_step or not path:
             return
         logger.info("failure-save of staged step %d", step)
-        self.save_step_checkpoint(step, self._latest_path)
+        self.save_step_checkpoint(step, path, commit_timeout=timeout)
 
     def register_path(self, path: str):
         self._latest_path = path

@@ -4,17 +4,26 @@ Parity: reference `trainer/torch/flash_checkpoint/engine.py` (CheckpointEngine
 ABC :136, `save_state_dict_to_memory` :297, `save_to_storage` :409) and
 `full_ckpt_engine.py`.
 
-The engine runs inside each training process.  `save_to_memory` stages the
-sharded pytree into this process's shm segment (sub-second, blocks training);
-`save_to_storage` additionally enqueues an event for the agent-side
-`AsyncCheckpointSaver`, which persists shm → storage off the training path.
-In standalone mode (no agent) the engine hosts the saver daemon in-process.
+The engine runs inside each training process.  `save_to_memory` snapshots the
+sharded pytree ON DEVICE (jax.Arrays are immutable, so a device-to-device copy
+at HBM bandwidth is a consistent point-in-time snapshot — milliseconds) and
+returns; a drain thread then stages snapshot → shm (batched async D2H) off the
+training path.  `save_to_storage` additionally enqueues an event for the
+agent-side `AsyncCheckpointSaver`, which persists shm → storage.  In
+standalone mode (no agent) the engine hosts the saver daemon in-process.
+
+This is the TPU redesign of the reference's blocking tier: reference GPU→shm
+memcpy rides PCIe (fast), so shm is its fast tier; on TPU the fast tier is
+HBM itself and the D2H hop joins the async pipeline.  Training is blocked
+only for the device copy; a crash mid-drain loses only the in-flight
+checkpoint, exactly like a crash mid-memcpy in the reference.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from typing import Any, Dict, Optional
 
@@ -22,13 +31,14 @@ import numpy as np
 
 from ..common.constants import CheckpointConstant
 from ..common.log import get_logger
-from ..common.multi_process import SharedQueue
+from ..common.multi_process import SharedLock, SharedQueue
 from ..common.storage import CheckpointStorage, get_checkpoint_storage
 from .ckpt_saver import (
     AsyncCheckpointSaver,
     CheckpointEvent,
     load_step_metas,
     read_last_step,
+    shm_lock_name,
     step_dir,
 )
 from .shm_handler import SharedMemoryHandler, _np_dtype, flatten_state_dict
@@ -49,6 +59,9 @@ class CheckpointEngine:
         self._saver: Optional[AsyncCheckpointSaver] = None
         self._event_queue: Optional[SharedQueue] = None
         self._latest_step = -1
+        self._drain_thread: Optional[threading.Thread] = None
+        self._drain_error: Optional[BaseException] = None
+        self._snapshot_fn = None  # jitted tree-copy, cached across saves
         if standalone is None:
             # a worker launched by an elastic agent must attach to the agent's
             # saver queue, never host its own (socket-name collision)
@@ -67,36 +80,146 @@ class CheckpointEngine:
         else:
             self._event_queue = SharedQueue(f"{job_name}-ckpt-events",
                                             master=False)
+        # client side of the saver's per-segment lock: staging must not
+        # overwrite the payload while the saver streams it to disk
+        self._shm_lock = SharedLock(shm_lock_name(job_name, local_rank),
+                                    master=False)
+
+    def _stage_locked(self, state: Any, step: int, extra: Dict):
+        acquired = False
+        try:
+            acquired = self._shm_lock.acquire(
+                timeout=CheckpointConstant.SAVE_TIMEOUT)
+        except Exception:  # noqa: BLE001 — saver gone: stage unlocked
+            acquired = False
+        try:
+            self._shm_handler.save_state_dict(state, step, extra)
+        finally:
+            if acquired:
+                try:
+                    self._shm_lock.release()
+                except Exception:  # noqa: BLE001
+                    pass
 
     # ------------------------------------------------------------------ save
 
-    def save_to_memory(self, step: int, state: Any,
-                       extra_meta: Optional[Dict] = None,
-                       path: Optional[str] = None) -> float:
-        """Stage pytree into shm; returns blocking time in seconds."""
+    def _device_snapshot(self, state: Any) -> Any:
+        """Point-in-time copy of a pytree: device leaves get a fresh device
+        buffer at HBM bandwidth, host leaves a numpy copy.
+
+        The copy decouples the checkpoint from buffer donation in the train
+        step: the snapshot's buffers are never donated, so the drain thread
+        can read them while training rolls forward.  The whole tree is copied
+        in ONE jitted call — per-leaf `jnp.copy` pays one host→device command
+        round-trip per leaf (~seconds for a transformer state over a remote
+        tunnel); a single dispatch is O(ms) after the first trace.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        leaves = jax.tree.leaves(state)
+        if not any(hasattr(x, "addressable_shards") for x in leaves):
+            return jax.tree.map(lambda x: np.copy(np.asarray(x)), state)
+        if self._snapshot_fn is None:
+            self._snapshot_fn = jax.jit(
+                lambda t: jax.tree.map(jnp.copy, t))
+        snap = self._snapshot_fn(state)
+        # await the smallest leaf: surfaces an allocation failure HERE (where
+        # the caller can fall back) instead of asynchronously in the drain
+        # thread; costs one scalar-sized readback
+        small = min(jax.tree.leaves(snap), key=lambda x: x.size)
+        np.asarray(small)
+        return snap
+
+    def _wait_drain(self, timeout: Optional[float] = None):
+        t = self._drain_thread
+        if t is not None and t.is_alive():
+            t.join(timeout)
+            if t.is_alive():
+                raise TimeoutError(
+                    f"checkpoint staging of step {self._latest_step} still "
+                    f"in flight after {timeout}s")
+        if self._drain_error is not None:
+            err, self._drain_error = self._drain_error, None
+            raise err
+
+    def _drain(self, snapshot: Any, step: int, extra: Dict,
+               storage_path: Optional[str]):
+        """Background: snapshot → shm (batched async D2H), then hand off."""
+        try:
+            self._stage_locked(snapshot, step, extra)
+            if storage_path is not None:
+                self._event_queue.put(CheckpointEvent.save(step,
+                                                           storage_path))
+        except BaseException as e:  # noqa: BLE001 — surfaced on next save
+            logger.exception("checkpoint drain of step %d failed", step)
+            self._drain_error = e
+
+    def _start_save(self, step: int, state: Any, extra_meta: Optional[Dict],
+                    path: Optional[str],
+                    storage_path: Optional[str]) -> float:
         t0 = time.time()
+        self._wait_drain()  # one staging at a time keeps the segment whole
         extra = dict(extra_meta or {})
         # tag the segment with its checkpoint dir so a later process can't
         # restore a stale segment left over from an unrelated job run
         extra.setdefault("_ckpt_dir", path or self.checkpoint_dir)
-        self._shm_handler.save_state_dict(state, step, extra)
+        try:
+            snapshot = self._device_snapshot(state)
+        except Exception as e:  # noqa: BLE001
+            # state too big to double-buffer in HBM (e.g. GPT-2 xl + AdamW on
+            # a 16GB chip): fall back to synchronous staging straight from
+            # the live buffers — slower blocking save, but correct
+            from ..common.util import is_oom_error
+
+            if not is_oom_error(e):
+                raise
+            logger.warning("device snapshot does not fit HBM; staging "
+                           "synchronously (%s)", type(e).__name__)
+            self._stage_locked(state, step, extra)
+            self._latest_step = step
+            if storage_path is not None:
+                self._event_queue.put(CheckpointEvent.save(step,
+                                                           storage_path))
+            return time.time() - t0
         self._latest_step = step
+        self._drain_thread = threading.Thread(
+            target=self._drain, args=(snapshot, step, extra, storage_path),
+            daemon=True, name="dwt-ckpt-drain")
+        self._drain_thread.start()
         return time.time() - t0
+
+    def save_to_memory(self, step: int, state: Any,
+                       extra_meta: Optional[Dict] = None,
+                       path: Optional[str] = None) -> float:
+        """Snapshot on device + async stage into shm; returns blocking s."""
+        return self._start_save(step, state, extra_meta, path, None)
 
     def save_to_storage(self, step: int, state: Any,
                         path: Optional[str] = None,
                         extra_meta: Optional[Dict] = None) -> float:
-        """Stage + hand off to the async saver. Returns blocking seconds."""
-        blocked = self.save_to_memory(step, state, extra_meta, path)
+        """Snapshot + async stage + hand off to the async saver."""
         path = path or self.checkpoint_dir
         if self._saver is not None:
             self._saver.register_path(path)
-        self._event_queue.put(CheckpointEvent.save(step, path))
-        return blocked
+        return self._start_save(step, state, extra_meta, path, path)
+
+    def wait_staging(self, timeout: Optional[float] = None):
+        """Block until the in-flight snapshot→shm staging (if any) lands."""
+        self._wait_drain(timeout)
 
     def wait_saving_latest(self, timeout: float = 600.0) -> bool:
-        """Block until the latest staged step is committed (for tests/exit)."""
+        """Block until the latest staged step is committed (for tests/exit).
+
+        Keeps the bool contract: staging timeouts/errors → False, not raise.
+        """
         deadline = time.time() + timeout
+        try:
+            self._wait_drain(timeout)
+        except (TimeoutError, Exception):  # noqa: BLE001
+            logger.warning("staging did not complete within %ss", timeout,
+                           exc_info=True)
+            return False
         while time.time() < deadline:
             if read_last_step(self.checkpoint_dir,
                               self.storage) >= self._latest_step:
@@ -112,6 +235,7 @@ class CheckpointEngine:
 
         Names containing ``#shardN`` are assembled into full global arrays.
         """
+        self._wait_drain()  # an in-flight staging must land before reading
         shm = self._shm_handler.load_state_dict()
         if shm is not None and (step is None or shm[0] == step):
             shm_step, flat, metas, extra = shm
@@ -176,7 +300,12 @@ class CheckpointEngine:
                    read_last_step(self.checkpoint_dir, self.storage))
 
     def close(self):
+        try:
+            self._wait_drain(timeout=600)
+        except BaseException:  # noqa: BLE001 — teardown must proceed
+            logger.exception("pending checkpoint drain failed during close")
         self._shm_handler.close()
+        self._shm_lock.close()
         if self._event_queue is not None and self._saver is None:
             self._event_queue.close()
 

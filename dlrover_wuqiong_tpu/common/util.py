@@ -1,0 +1,15 @@
+"""Small shared helpers."""
+
+from __future__ import annotations
+
+
+def is_oom_error(exc: BaseException) -> bool:
+    """True when `exc` is an accelerator out-of-memory failure.
+
+    XLA surfaces OOM as XlaRuntimeError with a RESOURCE_EXHAUSTED status (or
+    an "out of memory"-style message on some backends); there is no typed
+    exception to catch, so callers that want a fallback path share this
+    single string heuristic.
+    """
+    r = repr(exc)
+    return "RESOURCE_EXHAUSTED" in r or "emory" in r

@@ -46,7 +46,9 @@ def top_k_gating(logits: jax.Array, k: int, capacity: int,
     T, E = logits.shape
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
 
-    # iteratively pick top-k experts per token, masking chosen ones
+    # iteratively pick top-k experts per token, masking chosen ones with
+    # -inf (multiplying probs by 0 re-selects expert 0 when a token's
+    # remaining probs underflow to an all-zero row)
     combine = jnp.zeros((T, E, capacity), jnp.float32)
     dispatch = jnp.zeros((T, E, capacity), bool)
     masked = probs
@@ -69,7 +71,7 @@ def top_k_gating(logits: jax.Array, k: int, capacity: int,
         combine = combine + jnp.where(keep[:, None, None], contrib, 0.0)
         dispatch = dispatch | (jnp.where(keep[:, None, None], contrib, 0.0)
                                > 0)
-        masked = masked * (1.0 - onehot.astype(jnp.float32))
+        masked = jnp.where(onehot > 0, -jnp.inf, masked)
 
     # Switch-style load balance loss on the top-1 assignment distribution
     top1 = jax.nn.one_hot(jnp.argmax(probs, -1), E, dtype=jnp.float32)
@@ -133,8 +135,13 @@ class MoEMLP(nn.Module):
 
 
 def collect_moe_aux_loss(intermediates) -> jax.Array:
-    """Sum every sown `moe_aux_loss` in an intermediates collection."""
+    """Sum only the sown `moe_aux_loss` leaves of an intermediates
+    collection — any other sown diagnostic (attention stats, logging
+    metrics) must not silently become a loss term."""
     total = jnp.zeros((), jnp.float32)
-    for leaf in jax.tree.leaves(intermediates):
-        total = total + jnp.sum(leaf)
+    leaves = jax.tree_util.tree_flatten_with_path(intermediates)[0]
+    for path, leaf in leaves:
+        keys = [getattr(p, "key", getattr(p, "name", None)) for p in path]
+        if "moe_aux_loss" in keys:
+            total = total + jnp.sum(leaf)
     return total

@@ -145,7 +145,9 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l = l_scr[...]
         l_safe = jnp.where(l > 0, l, 1.0)
         o_ref[...] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
-        lse = jnp.where(l > 0, m_scr[...] + jnp.log(l_safe), jnp.inf)
+        # empty key set → logsumexp = -inf (matches the jnp reference path
+        # and long_context._merge_partials' isfinite handling)
+        lse = jnp.where(l > 0, m_scr[...] + jnp.log(l_safe), -jnp.inf)
         lse_ref[...] = lse
 
 
@@ -220,7 +222,10 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = jnp.where(
                 _causal_mask_block(qi, ki, block_q, block_k, kv_offset),
                 s, NEG_INF)
-        p = jnp.exp(s - lse)                    # 0 where masked / lse=inf
+        # lse = -inf marks a fully-masked row: its p must be 0, not
+        # exp(s + inf) = nan
+        finite = jnp.isfinite(lse)
+        p = jnp.where(finite, jnp.exp(s - jnp.where(finite, lse, 0.0)), 0.0)
         dp = _dot_t(do, v)
         ds = (p * (dp - delta) * sm_scale).astype(q.dtype)
         dq_scr[...] += _dot(ds, k)
@@ -276,7 +281,9 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = jnp.where(
                 _causal_mask_block(qi, ki, block_q, block_k, kv_offset),
                 s, NEG_INF)
-        p = jnp.exp(s - lse).astype(q.dtype)
+        finite = jnp.isfinite(lse)
+        p = jnp.where(finite, jnp.exp(s - jnp.where(finite, lse, 0.0)),
+                      0.0).astype(q.dtype)
         dv_scr[...] += _dot(p.T, do)
         dp = _dot_t(do, v)
         ds = (p.astype(jnp.float32) * (dp - delta) * sm_scale).astype(q.dtype)

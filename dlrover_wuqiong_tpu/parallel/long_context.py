@@ -51,6 +51,25 @@ except ImportError:  # pragma: no cover
                               out_specs=out_specs, check_rep=False)
 
 
+_BATCH_AXES = ("dp", "fsdp")  # mesh data axes (parallel/mesh.py AXIS_ORDER)
+
+
+def _qkv_spec(mesh: Mesh, seq_axis: str, batch_size: int) -> P:
+    """(b, h, S, d) spec: seq over `seq_axis`, batch over the mesh's data
+    axes.  Leaving batch unsharded would all-gather the global batch to every
+    device at the shard_map boundary and redundantly compute attention over
+    it, breaking the O(S/sp) memory claim under dp/fsdp>1.  Axes that don't
+    divide the batch are dropped (shard_map requires even division)."""
+    batch = []
+    div = 1
+    for a in _BATCH_AXES:
+        n = mesh.shape.get(a, 1) if a in mesh.axis_names else 1
+        if n > 1 and batch_size % (div * n) == 0:
+            batch.append(a)
+            div *= n
+    return P(tuple(batch) if batch else None, None, seq_axis, None)
+
+
 # ------------------------------------------------------------- lse utilities
 
 
@@ -135,7 +154,7 @@ def ring_attention(q, k, v, mesh: Mesh, causal: bool = True,
     if n == 1:
         return flash_attention(q, k, v, causal, sm_scale)
 
-    spec = P(None, None, axis, None)
+    spec = _qkv_spec(mesh, axis, q.shape[0])
     fn = shard_map(
         functools.partial(_ring_attention_local, axis_name=axis, n=n,
                           causal=causal, sm_scale=sm_scale),
@@ -178,7 +197,7 @@ def ulysses_attention(q, k, v, mesh: Mesh, causal: bool = True,
         raise ValueError(
             f"ulysses needs heads ({q.shape[1]}) divisible by {axis}={sp}")
 
-    spec = P(None, None, axis, None)
+    spec = _qkv_spec(mesh, axis, q.shape[0])
     fn = shard_map(
         functools.partial(_ulysses_local, axis_name=axis, causal=causal,
                           sm_scale=sm_scale),

@@ -1,0 +1,299 @@
+"""Pipeline parallelism over the mesh `pp` axis — GPipe-schedule SPMD.
+
+Parity: reference pipe compiler (`atorch/atorch/modules/distributed_modules/
+compilers/pipe_compiler/PipelineStage.py:115,922` — PiPPy stage split +
+1F1B/interleaved schedule over torch RPC) and
+`auto/opt_lib/pipeline_parallel_optimization.py:56`.
+
+TPU redesign: no RPC driver and no stage processes.  The layer stack is
+stacked into one pytree with a leading layer axis sharded `P("pp")`, and the
+schedule is a `lax.scan` over pipeline ticks inside `shard_map` restricted to
+the `pp` axis (`axis_names={"pp"}`): each tick every stage applies its local
+layer slice and hands its activation to the next stage with
+`jax.lax.ppermute` (ICI neighbor link).  All other mesh axes (dp/fsdp/tp/sp)
+stay in GSPMD "auto" mode inside the body, so pipeline composes with the rest
+of the strategy space.  Autodiff through scan+ppermute yields the reverse
+pipeline (fill-drain backward), which is exactly the GPipe schedule; the
+bubble fraction is (pp-1)/(M+pp-1) for M microbatches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..common.log import get_logger
+
+logger = get_logger("pipeline")
+
+try:
+    from jax import shard_map as _shard_map  # jax >= 0.8 style
+except ImportError:  # pragma: no cover
+    _shard_map = None
+
+
+def _pp_shard_map(f, mesh, in_specs, out_specs):
+    """shard_map manual over ONLY the pp axis; other axes stay GSPMD."""
+    if _shard_map is None:  # pragma: no cover
+        raise RuntimeError("pipeline parallelism needs jax.shard_map with "
+                           "axis_names support (jax >= 0.6)")
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      axis_names={"pp"}, check_vma=False)
+
+
+def pipeline_apply(block_fn: Callable[[Any, jax.Array], jax.Array],
+                   stacked_params: Any, x: jax.Array, mesh: Mesh,
+                   num_microbatches: int) -> jax.Array:
+    """Run a stacked layer pytree as a `pp`-stage pipeline over `x`.
+
+    Args:
+        block_fn: (one_layer_params, x) -> x, applied per layer.
+        stacked_params: pytree whose leaves have a leading layer axis L
+            (sharded P("pp") — L must divide evenly by pp).
+        x: (B, T, C) activations, replicated over pp.
+        num_microbatches: M; must divide B.
+    Returns (B, T, C), replicated over pp.
+    """
+    pp = mesh.shape.get("pp", 1)
+    if pp == 1:
+        def _layer(h, pl):
+            return block_fn(pl, h), None
+        return jax.lax.scan(_layer, x, stacked_params)[0]
+
+    B = x.shape[0]
+    M = num_microbatches
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by microbatches {M}")
+    xm = x.reshape(M, B // M, *x.shape[1:])
+
+    def _stage_body(sp_local, xm_full):
+        # sp_local leaves: (L/pp, ...) — this stage's layer slice
+        # xm_full: (M, b, T, C) — replicated over pp
+        stage = jax.lax.axis_index("pp")
+        n_ticks = M + pp - 1
+        fwd_perm = [(i, i + 1) for i in range(pp - 1)]
+
+        def _apply_stage(h):
+            def _layer(h, pl):
+                return block_fn(pl, h), None
+            return jax.lax.scan(_layer, h, sp_local)[0]
+
+        def _tick(carry, t):
+            buf, outs = carry
+            mb_in = jnp.clip(t, 0, M - 1)
+            h_in = jnp.where(stage == 0, xm_full[mb_in], buf)
+            y = _apply_stage(h_in)
+            # hand activation to the next stage (no wraparound)
+            buf_next = jax.lax.ppermute(y, "pp", fwd_perm)
+            # last stage finished microbatch t-(pp-1) at this tick
+            out_idx = t - (pp - 1)
+            write = (stage == pp - 1) & (out_idx >= 0)
+            outs_upd = outs.at[jnp.clip(out_idx, 0, M - 1)].set(y)
+            outs = jnp.where(write, outs_upd, outs)
+            return (buf_next, outs), None
+
+        buf0 = jnp.zeros_like(xm_full[0])
+        outs0 = jnp.zeros_like(xm_full)
+        (_, outs), _ = jax.lax.scan(_tick, (buf0, outs0),
+                                    jnp.arange(n_ticks))
+        # only the last stage holds real outputs; broadcast over pp so the
+        # head computes identically (and cheaply) on every stage
+        outs = jax.lax.psum(
+            jnp.where(stage == pp - 1, outs, jnp.zeros_like(outs)), "pp")
+        return outs
+
+    out = _pp_shard_map(
+        _stage_body, mesh,
+        in_specs=(P("pp"), P()), out_specs=P())(stacked_params, xm)
+    return out.reshape(B, *x.shape[1:])
+
+
+# --------------------------------------------------------- model integration
+
+
+_LAYER_RE = re.compile(r"^(h|layers)_(\d+)$")
+
+
+def split_layer_params(params: Dict) -> Tuple[Dict, List[Dict], str]:
+    """Split a flax param dict into (non_layer, [layer_0..layer_{L-1}], key
+    prefix).  Layers are the `h_<i>` / `layers_<i>` subtrees."""
+    non_layer, layers = {}, {}
+    prefix = None
+    for k, v in params.items():
+        m = _LAYER_RE.match(k)
+        if m:
+            prefix = m.group(1)
+            layers[int(m.group(2))] = v
+        else:
+            non_layer[k] = v
+    ordered = [layers[i] for i in range(len(layers))]
+    if not ordered:
+        raise ValueError("model has no h_<i>/layers_<i> blocks to pipeline")
+    return non_layer, ordered, prefix or "h"
+
+
+def stack_layer_params(layers: List[Dict]) -> Dict:
+    """[per-layer pytree] -> one pytree with leading layer axis."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+
+
+def unstack_layer_params(stacked: Dict, n: int) -> List[Dict]:
+    return [jax.tree.map(lambda x: x[i], stacked) for i in range(n)]
+
+
+@dataclasses.dataclass
+class PipelinedLM:
+    """Wraps a block-structured LM (GPT/Llama family) for pp execution.
+
+    Looks like a model to the rest of the stack: has `.config`, `.apply`,
+    `.init_params`.  Params restructure to {non-layer..., "blocks": stacked}.
+    """
+
+    inner: Any  # the wrapped flax module
+    mesh: Mesh
+    num_microbatches: int
+
+    def __post_init__(self):
+        self.config = self.inner.config
+        self._n_layer = getattr(self.config, "n_layer",
+                                getattr(self.config, "num_layers", 0))
+
+    # -- param plumbing
+
+    def init_params(self, rng, **kw):
+        p = dict(self.inner.init_params(rng, **kw))
+        non_layer, layers, self._prefix = split_layer_params(p)
+        out = dict(non_layer)
+        out["blocks"] = stack_layer_params(layers)
+        return out
+
+    def to_flat_params(self, params: Dict) -> Dict:
+        """Pipelined layout -> the inner model's layout (for export)."""
+        out = {k: v for k, v in params.items() if k != "blocks"}
+        for i, lp in enumerate(unstack_layer_params(params["blocks"],
+                                                    self._n_layer)):
+            out[f"{getattr(self, '_prefix', 'h')}_{i}"] = lp
+        return out
+
+    # -- forward
+
+    def apply(self, variables, idx, deterministic: bool = True,
+              mutable: Any = None):
+        params = variables["params"]
+        cfg = self.config
+        x = self._embed(params, idx)
+        block_fn = self._block_fn(params, idx, deterministic)
+        x = pipeline_apply(block_fn, params["blocks"], x, self.mesh,
+                           self.num_microbatches)
+        logits = self._head(params, x)
+        if mutable:
+            return logits, {}
+        return logits
+
+    def __call__(self, *a, **kw):  # pragma: no cover - convenience
+        return self.apply(*a, **kw)
+
+    # -- model-family adapters (embed / block / head built from the same
+    #    flax modules the inner model uses, so numerics match exactly)
+
+    def _embed(self, params, idx):
+        import flax.linen as nn
+
+        cfg = self.config
+        T = idx.shape[1]
+        if "wte" in params:  # GPT family (models/gpt.py)
+            tok = nn.Embed(cfg.vocab_size, cfg.n_embd, dtype=cfg.dtype).apply(
+                {"params": params["wte"]}, idx)
+            pos = nn.Embed(cfg.block_size, cfg.n_embd, dtype=cfg.dtype).apply(
+                {"params": params["wpe"]}, jnp.arange(T)[None, :])
+            return tok + pos
+        # Llama family (models/llama.py)
+        return nn.Embed(cfg.vocab_size, cfg.hidden_size,
+                        dtype=cfg.dtype).apply(
+            {"params": params["embed_tokens"]}, idx)
+
+    def _block_fn(self, params, idx, deterministic):
+        cfg = self.config
+        if "wte" in params:
+            from ..models.gpt import Block
+
+            fn = lambda pl, h: Block(cfg).apply(  # noqa: E731
+                {"params": pl}, h, deterministic)
+        else:
+            from ..models.llama import LlamaBlock, rope_freqs
+
+            T = idx.shape[1]
+            cos, sin = rope_freqs(cfg.head_dim, T, cfg.rope_theta)
+            fn = lambda pl, h: LlamaBlock(cfg).apply(  # noqa: E731
+                {"params": pl}, h, cos, sin)
+        if getattr(cfg, "remat", False):
+            fn = jax.checkpoint(fn, prevent_cse=False)
+        return fn
+
+    def _head(self, params, x):
+        import flax.linen as nn
+
+        cfg = self.config
+        if "wte" in params:
+            x = nn.LayerNorm(dtype=cfg.dtype).apply(
+                {"params": params["ln_f"]}, x)
+            wte = params["wte"]["embedding"]
+            return jnp.einsum("bte,ve->btv", x, wte.astype(cfg.dtype))
+        from ..models.llama import RMSNorm
+
+        x = RMSNorm(cfg.rms_eps, cfg.dtype).apply(
+            {"params": params["norm"]}, x)
+        return nn.Dense(cfg.vocab_size, use_bias=False,
+                        dtype=cfg.dtype).apply(
+            {"params": params["lm_head"]}, x)
+
+
+class PipelineShardingPlanner:
+    """Decorates a ShardingPlanner: `blocks/...` leaves get P("pp", *inner).
+
+    The stacked leading layer axis shards over pp; the remaining dims reuse
+    the transformer TP/FSDP rules evaluated against the same path.
+    """
+
+    def __init__(self, base):
+        self._base = base
+        self.mesh = base.mesh
+        self.rules = base.rules
+
+    def __getattr__(self, name):
+        return getattr(self._base, name)
+
+    def param_specs(self, params: Any) -> Any:
+        from .sharding import _add_fsdp, path_of, spec_for_path
+
+        def _spec(key_path, leaf):
+            path = path_of(key_path)
+            if path.startswith("blocks/"):
+                inner = spec_for_path(path, self.rules, ndim=leaf.ndim - 1)
+                inner = _add_fsdp(inner, tuple(leaf.shape[1:]), self.mesh,
+                                  self._base.fsdp_min_size)
+                return P("pp", *tuple(inner) + (None,) * (
+                    leaf.ndim - 1 - len(tuple(inner))))
+            spec = spec_for_path(path, self.rules, ndim=leaf.ndim)
+            return _add_fsdp(spec, tuple(leaf.shape), self.mesh,
+                             self._base.fsdp_min_size)
+
+        return jax.tree_util.tree_map_with_path(_spec, params)
+
+    def param_shardings(self, params: Any) -> Any:
+        from jax.sharding import NamedSharding
+
+        return jax.tree.map(lambda s: NamedSharding(self.mesh, s),
+                            self.param_specs(params),
+                            is_leaf=lambda x: isinstance(x, P))
+
+    def batch_sharding(self, *a, **kw):
+        return self._base.batch_sharding(*a, **kw)
+
+    def replicated(self):
+        return self._base.replicated()

@@ -180,3 +180,81 @@ class TestFlashCheckpointer:
         blocked = ckpt.save_checkpoint(1, big, storage_type=StorageType.MEMORY)
         assert blocked < 1.0
         ckpt.close()
+
+
+class TestMultiNodeCommit:
+    def test_tracker_waits_for_all_world_shards(self, tmp_path):
+        """Node-0's agent must not publish the tracker until every rank's
+        done-file lands (reference ckpt_saver.py:863) — a premature tracker
+        is a torn checkpoint on any multi-node job."""
+        import threading
+
+        from dlrover_wuqiong_tpu.common.constants import CheckpointConstant
+
+        path = str(tmp_path / "mn")
+        saver0 = AsyncCheckpointSaver(job_name="t-mn0", local_shard_num=1,
+                                      node_rank=0, world_shard_num=2)
+        saver1 = AsyncCheckpointSaver(job_name="t-mn1", local_shard_num=1,
+                                      node_rank=1, world_shard_num=2)
+        try:
+            h0 = SharedMemoryHandler(0, "t-mn0")
+            h0.save_state_dict({"w": np.ones((4,), np.float32)}, step=3)
+            h1 = SharedMemoryHandler(0, "t-mn1")
+            h1.save_state_dict({"w": np.ones((4,), np.float32) * 2}, step=3)
+
+            done0 = threading.Event()
+
+            def _node0_save():
+                saver0.save_step_checkpoint(3, path, commit_timeout=30)
+                done0.set()
+
+            t = threading.Thread(target=_node0_save, daemon=True)
+            t.start()
+            time.sleep(1.5)  # node 0 alone: commit must still be waiting
+            tracker = os.path.join(path, CheckpointConstant.TRACKER_FILE)
+            assert not done0.is_set()
+            assert not os.path.exists(tracker), "premature tracker publish"
+
+            saver1.save_step_checkpoint(3, path)  # rank!=0 never commits
+            assert done0.wait(timeout=30)
+            assert read_last_step(path) == 3
+        finally:
+            saver0._shm_handlers[0].unlink()
+            saver1._shm_handlers[0].unlink()
+            saver0._event_queue.close()
+            saver1._event_queue.close()
+
+    def test_node1_global_rank_offset(self, tmp_path):
+        path = str(tmp_path / "gr")
+        saver = AsyncCheckpointSaver(job_name="t-gr1", local_shard_num=1,
+                                     node_rank=1, world_shard_num=2)
+        try:
+            h = SharedMemoryHandler(0, "t-gr1")
+            h.save_state_dict({"w": np.zeros((2,), np.float32)}, step=1)
+            saver.save_step_checkpoint(1, path)
+            sdir = os.path.join(path, "checkpoint-1")
+            assert os.path.exists(os.path.join(sdir, "meta_rank1.json"))
+            assert os.path.exists(os.path.join(sdir, ".done", "rank1.done"))
+        finally:
+            saver._shm_handlers[0].unlink()
+            saver._event_queue.close()
+
+
+class TestTeardownFlush:
+    def test_stop_persists_memory_only_checkpoint(self, tmp_path):
+        """A MEMORY-only save newer than the last persisted step must be
+        flushed to storage on clean teardown, not discarded with the shm
+        segment (reference save_shm_to_storage on teardown, :634)."""
+        ckpt_dir = str(tmp_path / "flush")
+        ckpt = FlashCheckpointer(ckpt_dir, job_name="t-flush1",
+                                 standalone=True)
+        state = {"w": jnp.arange(8, dtype=jnp.float32)}
+        ckpt.save_checkpoint(4, state, storage_type=StorageType.MEMORY)
+        ckpt.close()
+        AsyncCheckpointSaver.reset()  # triggers saver.stop() → flush
+        assert read_last_step(ckpt_dir) == 4
+        eng = CheckpointEngine(ckpt_dir, job_name="t-flush2",
+                               standalone=True)
+        flat = eng.load_from_storage()
+        np.testing.assert_array_equal(flat["w"], np.arange(8))
+        eng.close()
