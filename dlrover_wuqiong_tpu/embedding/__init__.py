@@ -1,0 +1,27 @@
+"""Sparse-embedding service: dynamic-vocabulary embedding tables on TPU.
+
+Parity axis: the reference's tfplus `kv_variable` subsystem (SURVEY.md §2.4)
+— KvVariable hash-table embeddings, group sparse optimizers, frequency/
+timestamp tracking, full+delta import/export — redesigned for TPU as a host
+C++ id→slot control plane plus a dense mesh-sharded device value table.
+"""
+
+from .kv_embedding import KvEmbedding
+from .kv_store import NativeKvStore, PyKvStore, create_kv_store
+from .sparse_optim import (
+    SparseOptConfig,
+    apply_sparse_update,
+    dedup_grads,
+    init_slot_state,
+)
+
+__all__ = [
+    "KvEmbedding",
+    "NativeKvStore",
+    "PyKvStore",
+    "create_kv_store",
+    "SparseOptConfig",
+    "apply_sparse_update",
+    "dedup_grads",
+    "init_slot_state",
+]

@@ -1,0 +1,299 @@
+"""KvEmbedding — dynamic-vocabulary embedding table for TPU training.
+
+Parity: reference KvVariable ops —
+  `tfplus/tfplus/kv_variable/kernels/kv_variable.h:89` (insert-or-default
+  gather, frequency tracking, low-freq filtering),
+  `ops/kv_variable_ops.cc:37-708` (GatherOrInsert/GatherOrZeros, scatter ops,
+  Import/Export V2/V3, FullOrDeltaImport/Export),
+  `kernels/hybrid_embedding/table_manager.h` (tiered storage/eviction).
+
+TPU architecture (two planes):
+  host control plane — the C++ `KvStore` maps raw int64 ids → dense row
+    slots, tracks per-key frequency/recency, recycles evicted slots and
+    records dirty rows for delta export.  Runs in the input pipeline, OUT of
+    jit (host work overlaps device compute like any data loading).
+  device data plane — `values` is a dense (capacity, dim) jnp array (mesh-
+    shardable over fsdp/ep) gathered by slot inside the jit'd step; sparse
+    optimizer states are parallel tables updated by `apply_sparse_update`
+    with static-shape scatters.  Capacity growth doubles the table with a
+    pad (device-side copy), keeping all shapes static between growths so
+    recompiles happen only at growth events (amortized O(log vocab)).
+
+Low-frequency filtering (reference under-flow policy): ids seen fewer than
+`min_freq` times read/write the reserved null row 0, so one-off junk ids
+never consume vocabulary and never train.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..common.log import get_logger
+from .kv_store import create_kv_store
+from .sparse_optim import (
+    SparseOptConfig,
+    apply_sparse_update,
+    dedup_grads,
+    init_slot_state,
+)
+
+logger = get_logger("kv_embedding")
+
+_NULL_SLOT = 0  # reserved row for filtered / unseen ids
+_SENTINEL_KEY = -(1 << 62)  # the id pinned to the null row
+
+
+class KvEmbedding:
+    def __init__(self, dim: int, capacity: int = 1024,
+                 optimizer: Optional[SparseOptConfig] = None,
+                 min_freq: int = 0, init_scale: float = 0.01,
+                 dtype=None, sharding=None, seed: int = 0,
+                 prefer_native: bool = True):
+        import jax
+        import jax.numpy as jnp
+
+        self.dim = dim
+        self.opt = optimizer or SparseOptConfig()
+        self.min_freq = min_freq
+        self.init_scale = init_scale
+        self.dtype = dtype or jnp.float32
+        self.sharding = sharding
+        self._seed = seed
+        self.store = create_kv_store(capacity, prefer_native=prefer_native)
+        # slot 0 is the null row: stays zero, absorbs filtered ids
+        self.store.lookup_or_insert(np.array([_SENTINEL_KEY], np.int64))
+        self.values = self._init_rows(capacity, 0)
+        self.slot_state = init_slot_state(self.opt, capacity, dim, self.dtype)
+        if sharding is not None:
+            self.values = jax.device_put(self.values, sharding)
+            self.slot_state = {k: jax.device_put(v, sharding)
+                               for k, v in self.slot_state.items()}
+
+    # ------------------------------------------------------------ host plane
+
+    def _init_rows(self, n: int, offset: int):
+        import jax
+        import jax.numpy as jnp
+
+        key = jax.random.fold_in(jax.random.PRNGKey(self._seed), offset)
+        rows = jax.random.normal(key, (n, self.dim), self.dtype) * \
+            self.init_scale
+        if offset == 0:
+            rows = rows.at[_NULL_SLOT].set(0.0)
+        return rows
+
+    def lookup_slots(self, ids: np.ndarray, insert: bool = True,
+                     train: bool = True) -> np.ndarray:
+        """ids → row slots (host path, runs in the input pipeline).
+
+        insert=True gives GatherOrInsert semantics (new ids get fresh rows,
+        growing capacity when full); insert=False gives GatherOrZeros (the
+        null row).  Low-frequency ids map to the null row until their count
+        reaches `min_freq`.
+        """
+        ids = np.ascontiguousarray(ids, np.int64)
+        if insert:
+            # grow via callback: the store resumes the batch from the first
+            # unprocessed key, so frequencies are counted exactly once even
+            # across growth events
+            slots, n_new = self.store.lookup_or_insert(
+                ids, grow_fn=lambda: self.grow(self.store.capacity * 2))
+            if n_new:
+                logger.debug("admitted %d new ids (vocab=%d)", n_new,
+                             len(self.store))
+        else:
+            slots = self.store.lookup(ids)
+            slots = np.where(slots < 0, _NULL_SLOT, slots)
+        if self.min_freq > 1 and train:
+            freq = self.store.freq(slots)
+            slots = np.where(freq >= self.min_freq, slots, _NULL_SLOT)
+        return slots
+
+    def grow(self, new_capacity: int):
+        """Double host metadata + pad the device tables (static shapes
+        between growths ⇒ recompiles only at growth events)."""
+        import jax
+        import jax.numpy as jnp
+
+        old = self.store.capacity
+        if new_capacity <= old:
+            return
+        self.store.grow(new_capacity)
+        pad = self._init_rows(new_capacity - old, old)
+        self.values = jnp.concatenate([self.values, pad], axis=0)
+        self.slot_state = {
+            k: jnp.concatenate(
+                [v, jnp.zeros((new_capacity - old,) + v.shape[1:],
+                              v.dtype)], axis=0)
+            for k, v in self.slot_state.items()}
+        if self.sharding is not None:
+            self.values = jax.device_put(self.values, self.sharding)
+            self.slot_state = {k: jax.device_put(v, self.sharding)
+                               for k, v in self.slot_state.items()}
+        logger.info("kv embedding grew %d → %d rows", old, new_capacity)
+
+    # ---------------------------------------------------------- device plane
+
+    def gather(self, slots) -> Any:
+        """(…,) slots → (…, dim) rows; works with numpy, jnp, or traced
+        slot arrays (plain indexing — no host round-trip)."""
+        return self.values[slots]
+
+    @staticmethod
+    def gather_from(values, slots):
+        """jit-friendly: table passed as an argument."""
+        return values[slots]
+
+    def apply_gradients(self, slots, grads, unique_bound: Optional[int] = None
+                        ) -> None:
+        """Sparse optimizer step on the touched rows (host-driven API).
+
+        slots: (n,) int array (may contain duplicates — deduped here);
+        grads: (n, dim).  For a fully-jit training step use
+        `apply_sparse_update` directly with the tables as step state.
+        """
+        import jax.numpy as jnp
+
+        slots = jnp.asarray(np.ascontiguousarray(slots, np.int32)).ravel()
+        grads = jnp.asarray(grads).reshape(slots.shape[0], self.dim)
+        # the null row must never train: filtered/unseen ids read zeros
+        # forever (reference low-freq filter invariant)
+        grads = jnp.where((slots == _NULL_SLOT)[:, None], 0.0, grads)
+        bound = unique_bound or slots.shape[0]
+        uniq, summed = dedup_grads(slots, grads, bound)
+        self.values, self.slot_state = apply_sparse_update(
+            self.opt, self.values, self.slot_state, uniq, summed)
+        uniq_np = np.asarray(uniq, np.int64)
+        self.store.mark_updated(uniq_np[uniq_np != _NULL_SLOT])
+
+    # ------------------------------------------------------- import / export
+
+    def export_full(self) -> Dict[str, np.ndarray]:
+        """Full checkpoint: keys + their rows (+ freq/ts + opt state rows).
+
+        Parity: KvVariableExportV2 (ops/kv_variable_ops.cc).
+        """
+        keys, slots, freqs, tss = self.store.export(with_meta=True)
+        return {
+            "keys": keys, "slots": slots, "freqs": freqs, "tss": tss,
+            "values": np.asarray(self.values[slots]),
+            **{f"opt_{k}": np.asarray(v[slots])
+               for k, v in self.slot_state.items()},
+        }
+
+    def export_delta(self) -> Tuple[Dict[str, np.ndarray], int]:
+        """Rows touched since the last `advance`d epoch + closes the epoch.
+
+        Parity: KvVariableFullOrDeltaExport (ops/kv_variable_ops.cc:633) —
+        the incremental checkpoint that makes frequent embedding snapshots
+        affordable when only a fraction of the vocabulary trains per
+        interval.
+        """
+        epoch = self.store.epoch
+        keys, slots = self.store.export_delta(epoch)
+        self.store.advance_epoch()
+        out = {"keys": keys, "slots": slots,
+               "values": np.asarray(self.values[slots]) if len(slots)
+               else np.zeros((0, self.dim), np.float32),
+               **{f"opt_{k}": np.asarray(v[slots]) if len(slots)
+                  else np.zeros((0,) + v.shape[1:], np.float32)
+                  for k, v in self.slot_state.items()}}
+        return out, epoch
+
+    def import_full(self, blob: Dict[str, np.ndarray]):
+        import jax.numpy as jnp
+
+        slots = blob["slots"]
+        if len(slots):
+            needed = int(np.max(slots)) + 1
+            if needed > self.store.capacity:
+                self.grow(max(needed, self.store.capacity * 2))
+            self.store.import_(blob["keys"], slots, blob.get("freqs"),
+                               blob.get("tss"))
+            self.values = self.values.at[slots].set(
+                jnp.asarray(blob["values"], self.dtype))
+            for k in self.slot_state:
+                if f"opt_{k}" in blob:
+                    self.slot_state[k] = self.slot_state[k].at[slots].set(
+                        jnp.asarray(blob[f"opt_{k}"],
+                                    self.slot_state[k].dtype))
+
+    def import_delta(self, blob: Dict[str, np.ndarray]):
+        """Apply an incremental export on top of the current state."""
+        self.import_full(blob)
+
+    # ------------------------------------------------------------- lifecycle
+
+    def evict_older_than(self, ts_threshold: int) -> int:
+        """Free rows not seen since `ts_threshold` (unix seconds).
+
+        Parity: KvVariableDeleteWithTimestamp.  Freed rows are re-initialized
+        so recycled slots don't leak stale embeddings to new keys.  The null
+        row (slot 0) is exempt: its sentinel mapping is restored and the row
+        re-zeroed so filtered ids keep reading zeros.
+        """
+        slots = self.store.evict_older_than(ts_threshold)
+        if _NULL_SLOT in slots:
+            # eviction swept the sentinel — reclaim slot 0 before anything
+            # else can: re-import pulls it off the free list
+            self.store.import_(np.array([_SENTINEL_KEY], np.int64),
+                               np.array([_NULL_SLOT], np.int64))
+            slots = slots[slots != _NULL_SLOT]
+        if len(slots):
+            import jax.numpy as jnp
+
+            fresh = self._init_rows(len(slots), int(slots[0]) + 1)
+            self.values = self.values.at[slots].set(fresh)
+            for k, v in self.slot_state.items():
+                self.slot_state[k] = v.at[slots].set(0)
+        return len(slots)
+
+    @property
+    def vocab_size(self) -> int:
+        return max(0, len(self.store) - 1)  # minus the reserved null row
+
+    @property
+    def capacity(self) -> int:
+        return self.store.capacity
+
+    # ------------------------------------------------- file-level save/load
+
+    def save(self, path: str, delta: bool = False) -> str:
+        """Write a (full or delta) export as .npz + manifest; returns path."""
+        os.makedirs(path, exist_ok=True)
+        if delta:
+            blob, epoch = self.export_delta()
+            fname = os.path.join(path, f"embedding-delta-{epoch}.npz")
+        else:
+            blob = self.export_full()
+            fname = os.path.join(path, "embedding-full.npz")
+        np.savez(fname, **blob)
+        manifest = os.path.join(path, "embedding-manifest.json")
+        entries = []
+        if os.path.exists(manifest):
+            with open(manifest) as f:
+                entries = json.load(f)
+        if not delta:
+            entries = []  # a full export restarts the chain
+        entries.append(os.path.basename(fname))
+        tmp = manifest + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(entries, f)
+        os.replace(tmp, manifest)
+        return fname
+
+    def load(self, path: str) -> bool:
+        """Restore from a full export + any delta chain after it."""
+        manifest = os.path.join(path, "embedding-manifest.json")
+        if not os.path.exists(manifest):
+            return False
+        with open(manifest) as f:
+            entries = json.load(f)
+        for fname in entries:
+            with np.load(os.path.join(path, fname)) as z:
+                self.import_full({k: z[k] for k in z.files})
+        return True

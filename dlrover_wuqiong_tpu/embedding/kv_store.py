@@ -1,0 +1,385 @@
+"""Host-side id→slot store: ctypes binding of the C++ concurrent hash table.
+
+Parity: reference `tfplus/tfplus/kv_variable/kernels/hashmap.h:1030`
+(concurrent map) and `kv_variable.h:89` (frequency/timestamp tracking,
+under/overflow policies).  See `native/kv_store.cc` for the TPU design notes.
+
+The shared library is compiled on first use with g++ (no pip deps — the
+environment bakes the toolchain, pybind11 is unavailable so the binding is a
+C ABI via ctypes).  A pure-Python store with the same interface backs
+environments without a compiler.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..common.log import get_logger
+
+logger = get_logger("kv_store")
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "native")
+_SRC = os.path.join(_NATIVE_DIR, "kv_store.cc")
+_LIB_CACHE: Optional[ctypes.CDLL] = None
+_LIB_LOCK = threading.Lock()
+_LIB_FAILED = False
+
+
+def _build_lib() -> Optional[ctypes.CDLL]:
+    """Compile kv_store.cc → .so (cached beside the source; falls back to a
+    tmp dir when the package directory is read-only)."""
+    global _LIB_CACHE, _LIB_FAILED
+    with _LIB_LOCK:
+        if _LIB_CACHE is not None:
+            return _LIB_CACHE
+        if _LIB_FAILED:
+            return None
+        candidates = [os.path.join(_NATIVE_DIR, "libkvstore.so"),
+                      os.path.join(tempfile.gettempdir(),
+                                   f"dwt_libkvstore_{os.getuid()}.so")]
+        for so in candidates:
+            if os.path.exists(so) and os.path.getmtime(so) >= \
+                    os.path.getmtime(_SRC):
+                try:
+                    _LIB_CACHE = _load(so)
+                    return _LIB_CACHE
+                except OSError:  # stale/foreign binary
+                    pass
+        for so in candidates:
+            try:
+                cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                       "-pthread", _SRC, "-o", so]
+                subprocess.run(cmd, check=True, capture_output=True,
+                               timeout=120)
+                _LIB_CACHE = _load(so)
+                logger.info("built native kv_store: %s", so)
+                return _LIB_CACHE
+            except (OSError, subprocess.SubprocessError) as e:
+                logger.warning("kv_store build at %s failed: %s", so, e)
+        _LIB_FAILED = True
+        logger.warning("native kv_store unavailable — using python store")
+        return None
+
+
+def _load(so: str) -> ctypes.CDLL:
+    lib = ctypes.CDLL(so)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    lib.kv_create.restype = ctypes.c_void_p
+    lib.kv_create.argtypes = [ctypes.c_int64, ctypes.c_int]
+    lib.kv_destroy.argtypes = [ctypes.c_void_p]
+    lib.kv_lookup_or_insert.restype = ctypes.c_int64
+    lib.kv_lookup_or_insert.argtypes = [ctypes.c_void_p, i64p,
+                                        ctypes.c_int64, i64p,
+                                        ctypes.c_uint32, i64p]
+    lib.kv_lookup.argtypes = [ctypes.c_void_p, i64p, ctypes.c_int64, i64p]
+    lib.kv_size.restype = ctypes.c_int64
+    lib.kv_size.argtypes = [ctypes.c_void_p]
+    lib.kv_capacity.restype = ctypes.c_int64
+    lib.kv_capacity.argtypes = [ctypes.c_void_p]
+    lib.kv_grow.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.kv_evict_older_than.restype = ctypes.c_int64
+    lib.kv_evict_older_than.argtypes = [ctypes.c_void_p, ctypes.c_uint32,
+                                        i64p, ctypes.c_int64]
+    lib.kv_export.restype = ctypes.c_int64
+    lib.kv_export.argtypes = [ctypes.c_void_p, i64p, i64p, u32p, u32p,
+                              ctypes.c_int64]
+    lib.kv_export_delta.restype = ctypes.c_int64
+    lib.kv_export_delta.argtypes = [ctypes.c_void_p, ctypes.c_uint32, i64p,
+                                    i64p, ctypes.c_int64]
+    lib.kv_advance_epoch.restype = ctypes.c_uint32
+    lib.kv_advance_epoch.argtypes = [ctypes.c_void_p]
+    lib.kv_current_epoch.restype = ctypes.c_uint32
+    lib.kv_current_epoch.argtypes = [ctypes.c_void_p]
+    lib.kv_import.restype = ctypes.c_int
+    lib.kv_import.argtypes = [ctypes.c_void_p, i64p, i64p, u32p, u32p,
+                              ctypes.c_int64]
+    lib.kv_get_freq.argtypes = [ctypes.c_void_p, i64p, ctypes.c_int64, u32p]
+    lib.kv_mark_updated.argtypes = [ctypes.c_void_p, i64p, ctypes.c_int64]
+    return lib
+
+
+def _i64(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _u32(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))
+
+
+class NativeKvStore:
+    """ctypes front of the C++ store."""
+
+    def __init__(self, capacity: int, num_shards: int = 64):
+        self._lib = _build_lib()
+        if self._lib is None:
+            raise RuntimeError("native kv_store unavailable")
+        self._h = self._lib.kv_create(capacity, num_shards)
+        self._destroy = self._lib.kv_destroy  # survive interpreter teardown
+
+    def __del__(self):  # pragma: no cover
+        try:
+            if getattr(self, "_h", None):
+                self._destroy(self._h)
+                self._h = None
+        except Exception:  # noqa: BLE001
+            pass
+
+    def lookup_or_insert(self, keys: np.ndarray, now: Optional[int] = None,
+                         grow_fn=None) -> Tuple[np.ndarray, int]:
+        """Returns (slots, num_new).
+
+        When the table fills mid-batch, `grow_fn()` is invoked (it must
+        raise or increase capacity) and the batch RESUMES from the first
+        unprocessed key — already-processed keys are never re-touched, so
+        frequency counts stay exact across growth events.  Without a
+        grow_fn a full table raises MemoryError.
+        """
+        flat = np.ascontiguousarray(keys, np.int64).ravel()
+        slots = np.empty(flat.size, np.int64)
+        now = int(now if now is not None else time.time()) & 0xFFFFFFFF
+        total_new = ctypes.c_int64(0)
+        off = 0
+        while off < flat.size:
+            done = self._lib.kv_lookup_or_insert(
+                self._h, _i64(flat[off:]), flat.size - off,
+                _i64(slots[off:]), now, ctypes.byref(total_new))
+            off += int(done)
+            if off < flat.size:
+                if grow_fn is None:
+                    raise MemoryError("kv store full")
+                grow_fn()
+        return slots.reshape(np.shape(keys)), int(total_new.value)
+
+    def lookup(self, keys: np.ndarray) -> np.ndarray:
+        keys = np.ascontiguousarray(keys, np.int64)
+        slots = np.empty(keys.size, np.int64)
+        self._lib.kv_lookup(self._h, _i64(keys.ravel()), keys.size,
+                            _i64(slots))
+        return slots.reshape(keys.shape)
+
+    def __len__(self):
+        return int(self._lib.kv_size(self._h))
+
+    @property
+    def capacity(self) -> int:
+        return int(self._lib.kv_capacity(self._h))
+
+    def grow(self, new_capacity: int):
+        self._lib.kv_grow(self._h, new_capacity)
+
+    def evict_older_than(self, ts_threshold: int,
+                         max_out: int = 1 << 20) -> np.ndarray:
+        out = np.empty(max_out, np.int64)
+        n = self._lib.kv_evict_older_than(self._h, ts_threshold & 0xFFFFFFFF,
+                                          _i64(out), max_out)
+        return out[:min(n, max_out)].copy()
+
+    def export(self, with_meta: bool = True):
+        """Returns (keys, slots[, freqs, tss])."""
+        n = self._lib.kv_export(self._h, _i64(np.empty(0, np.int64)),
+                                _i64(np.empty(0, np.int64)), None, None, 0)
+        keys = np.empty(n, np.int64)
+        slots = np.empty(n, np.int64)
+        freqs = np.empty(n, np.uint32) if with_meta else None
+        tss = np.empty(n, np.uint32) if with_meta else None
+        # the table may have changed between the sizing and fill calls —
+        # trim to what the fill actually wrote (never return garbage tail)
+        n2 = self._lib.kv_export(self._h, _i64(keys), _i64(slots),
+                                 _u32(freqs) if with_meta else None,
+                                 _u32(tss) if with_meta else None, n)
+        m = min(n, n2)
+        if with_meta:
+            return keys[:m], slots[:m], freqs[:m], tss[:m]
+        return keys[:m], slots[:m]
+
+    def export_delta(self, since_epoch: int):
+        cap = self.capacity
+        keys = np.empty(cap, np.int64)
+        slots = np.empty(cap, np.int64)
+        n = self._lib.kv_export_delta(self._h, since_epoch & 0xFFFFFFFF,
+                                      _i64(keys), _i64(slots), cap)
+        n = min(n, cap)
+        return keys[:n].copy(), slots[:n].copy()
+
+    def advance_epoch(self) -> int:
+        return int(self._lib.kv_advance_epoch(self._h))
+
+    @property
+    def epoch(self) -> int:
+        return int(self._lib.kv_current_epoch(self._h))
+
+    def import_(self, keys: np.ndarray, slots: np.ndarray,
+                freqs: Optional[np.ndarray] = None,
+                tss: Optional[np.ndarray] = None):
+        keys = np.ascontiguousarray(keys, np.int64)
+        slots = np.ascontiguousarray(slots, np.int64)
+        rc = self._lib.kv_import(
+            self._h, _i64(keys), _i64(slots),
+            _u32(np.ascontiguousarray(freqs, np.uint32))
+            if freqs is not None else None,
+            _u32(np.ascontiguousarray(tss, np.uint32))
+            if tss is not None else None, keys.size)
+        if rc != 0:
+            raise ValueError("import slot exceeds capacity — grow() first")
+
+    def freq(self, slots: np.ndarray) -> np.ndarray:
+        slots = np.ascontiguousarray(slots, np.int64)
+        out = np.empty(slots.size, np.uint32)
+        self._lib.kv_get_freq(self._h, _i64(slots.ravel()), slots.size,
+                              _u32(out))
+        return out.reshape(slots.shape)
+
+    def mark_updated(self, slots: np.ndarray):
+        slots = np.ascontiguousarray(slots, np.int64)
+        self._lib.kv_mark_updated(self._h, _i64(slots.ravel()), slots.size)
+
+
+class PyKvStore:
+    """Pure-Python fallback with the same interface (single-threaded dict)."""
+
+    def __init__(self, capacity: int, num_shards: int = 0):
+        self._cap = capacity
+        self._map = {}
+        self._free = []
+        self._next = 0
+        self._freq = np.zeros(capacity, np.uint32)
+        self._ts = np.zeros(capacity, np.uint32)
+        self._ver = np.zeros(capacity, np.uint32)
+        self._epoch = 1
+        self._lock = threading.Lock()
+
+    def lookup_or_insert(self, keys, now=None, grow_fn=None):
+        flat = np.ascontiguousarray(keys, np.int64).ravel().tolist()
+        now = int(now if now is not None else time.time()) & 0xFFFFFFFF
+        slots = np.empty(len(flat), np.int64)
+        n_new = 0
+        i = 0
+        while i < len(flat):
+            with self._lock:
+                while i < len(flat):
+                    k = flat[i]
+                    s = self._map.get(k)
+                    if s is None:
+                        if self._free:
+                            s = self._free.pop()
+                        elif self._next < self._cap:
+                            s = self._next
+                            self._next += 1
+                        else:
+                            break  # full — grow and resume from i
+                        self._map[k] = s
+                        self._freq[s] = 0
+                        n_new += 1
+                    self._freq[s] += 1
+                    self._ts[s] = now
+                    self._ver[s] = self._epoch
+                    slots[i] = s
+                    i += 1
+            if i < len(flat):
+                if grow_fn is None:
+                    raise MemoryError("kv store full")
+                grow_fn()
+        return slots.reshape(np.shape(keys)), n_new
+
+    def lookup(self, keys):
+        keys = np.ascontiguousarray(keys, np.int64)
+        return np.array([self._map.get(k, -1)
+                         for k in keys.ravel().tolist()],
+                        np.int64).reshape(keys.shape)
+
+    def __len__(self):
+        return len(self._map)
+
+    @property
+    def capacity(self):
+        return self._cap
+
+    def grow(self, new_capacity):
+        if new_capacity <= self._cap:
+            return
+        for arr_name in ("_freq", "_ts", "_ver"):
+            old = getattr(self, arr_name)
+            new = np.zeros(new_capacity, np.uint32)
+            new[:self._cap] = old
+            setattr(self, arr_name, new)
+        self._cap = new_capacity
+
+    def evict_older_than(self, ts_threshold, max_out=1 << 20):
+        out = []
+        with self._lock:
+            for k in [k for k, s in self._map.items()
+                      if self._ts[s] < ts_threshold]:
+                s = self._map.pop(k)
+                self._freq[s] = 0
+                self._free.append(s)
+                out.append(s)
+        return np.array(out, np.int64)
+
+    def export(self, with_meta=True):
+        keys = np.array(list(self._map.keys()), np.int64)
+        slots = np.array(list(self._map.values()), np.int64)
+        if with_meta:
+            return keys, slots, self._freq[slots].copy(), \
+                self._ts[slots].copy()
+        return keys, slots
+
+    def export_delta(self, since_epoch):
+        ks, ss = [], []
+        for k, s in self._map.items():
+            if self._ver[s] >= since_epoch:
+                ks.append(k)
+                ss.append(s)
+        return np.array(ks, np.int64), np.array(ss, np.int64)
+
+    def advance_epoch(self):
+        e, self._epoch = self._epoch, self._epoch + 1
+        return e
+
+    @property
+    def epoch(self):
+        return self._epoch
+
+    def import_(self, keys, slots, freqs=None, tss=None):
+        if len(slots) and int(np.max(slots)) >= self._cap:
+            raise ValueError("import slot exceeds capacity — grow() first")
+        for i, (k, s) in enumerate(zip(keys.tolist(), slots.tolist())):
+            self._map[int(k)] = int(s)
+            self._freq[s] = int(freqs[i]) if freqs is not None else 1
+            self._ts[s] = int(tss[i]) if tss is not None else 0
+        if len(slots):
+            self._next = max(self._next, int(np.max(slots)) + 1)
+            # imported slots must leave the recycle list, or a later insert
+            # hands the same row to a second key
+            imported = set(slots.tolist())
+            self._free = [s for s in self._free if s not in imported]
+
+    def freq(self, slots):
+        slots = np.ascontiguousarray(slots, np.int64)
+        out = np.where((slots >= 0) & (slots < self._cap),
+                       self._freq[np.clip(slots, 0, self._cap - 1)], 0)
+        return out.astype(np.uint32)
+
+    def mark_updated(self, slots):
+        s = np.ascontiguousarray(slots, np.int64).ravel()
+        s = s[(s >= 0) & (s < self._cap)]
+        self._ver[s] = self._epoch
+
+
+def create_kv_store(capacity: int, num_shards: int = 64,
+                    prefer_native: bool = True):
+    if prefer_native:
+        try:
+            return NativeKvStore(capacity, num_shards)
+        except (RuntimeError, OSError):
+            pass
+    return PyKvStore(capacity)

@@ -1,0 +1,400 @@
+// Concurrent id->slot hash store for the sparse-embedding service.
+//
+// Parity: reference tfplus KvVariable core —
+//   tfplus/tfplus/kv_variable/kernels/kv_variable.h:89 (KvVariable<K,V>),
+//   kernels/hashmap.h:1030 (libcuckoo-style concurrent map),
+//   kernels/kv_variable_interface.h (frequency/timestamp tracking),
+//   ops/kv_variable_ops.cc:633 (FullOrDeltaImport/Export).
+//
+// TPU redesign: the reference keeps embedding VALUES inside the C++ table
+// (CPU PS-style).  On TPU the values live in HBM as a dense mesh-sharded
+// (capacity, dim) array updated with XLA gather/scatter; this store only
+// owns the host-side control plane: key -> row-slot assignment, per-slot
+// frequency / last-seen timestamps, dirty versions for delta export, and
+// slot recycling after eviction.  That keeps the hot path (gather + sparse
+// optimizer update) entirely on the MXU/VPU while preserving the dynamic-
+// vocabulary semantics (insert-or-default, low-frequency filtering,
+// delete-by-timestamp).
+//
+// Concurrency: striped shards, each a std::unordered_map under a
+// shared_mutex (readers concurrent, writers per-stripe), an atomic slot
+// allocator and a mutex-guarded free list.  Exposed as a C ABI for ctypes.
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <shared_mutex>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+struct Shard {
+  std::shared_mutex mu;
+  std::unordered_map<int64_t, int64_t> map;  // key -> slot
+};
+
+class KvStore {
+ public:
+  KvStore(int64_t capacity, int num_shards)
+      : capacity_(capacity),
+        shards_(num_shards > 0 ? num_shards : 64),
+        freq_(new std::atomic<uint32_t>[capacity]),
+        ts_(new std::atomic<uint32_t>[capacity]),
+        version_(new std::atomic<uint32_t>[capacity]) {
+    slot_key_.resize(capacity, -1);
+    for (int64_t i = 0; i < capacity; ++i) {
+      freq_[i].store(0, std::memory_order_relaxed);
+      ts_[i].store(0, std::memory_order_relaxed);
+      version_[i].store(0, std::memory_order_relaxed);
+    }
+  }
+
+  Shard& shard_for(int64_t key) {
+    size_t h = std::hash<int64_t>()(static_cast<uint64_t>(key) * 0x9E3779B97F4A7C15ull);
+    return shards_[h % shards_.size()];
+  }
+
+  // Returns slot or -1 when the table is full (caller grows + retries).
+  int64_t lookup_or_insert(int64_t key, uint32_t now, bool* inserted) {
+    Shard& s = shard_for(key);
+    {
+      std::shared_lock<std::shared_mutex> rl(s.mu);
+      auto it = s.map.find(key);
+      if (it != s.map.end()) {
+        touch(it->second, now);
+        *inserted = false;
+        return it->second;
+      }
+    }
+    std::unique_lock<std::shared_mutex> wl(s.mu);
+    auto it = s.map.find(key);
+    if (it != s.map.end()) {
+      touch(it->second, now);
+      *inserted = false;
+      return it->second;
+    }
+    int64_t slot = alloc_slot();
+    if (slot < 0) return -1;
+    s.map.emplace(key, slot);
+    slot_key_[slot] = key;
+    freq_[slot].store(1, std::memory_order_relaxed);
+    ts_[slot].store(now, std::memory_order_relaxed);
+    version_[slot].store(epoch_.load(std::memory_order_relaxed),
+                         std::memory_order_relaxed);
+    *inserted = true;
+    return slot;
+  }
+
+  int64_t lookup(int64_t key) {
+    Shard& s = shard_for(key);
+    std::shared_lock<std::shared_mutex> rl(s.mu);
+    auto it = s.map.find(key);
+    return it == s.map.end() ? -1 : it->second;
+  }
+
+  void touch(int64_t slot, uint32_t now) {
+    freq_[slot].fetch_add(1, std::memory_order_relaxed);
+    ts_[slot].store(now, std::memory_order_relaxed);
+    version_[slot].store(epoch_.load(std::memory_order_relaxed),
+                         std::memory_order_relaxed);
+  }
+
+  // mark slots written by the optimizer as dirty in the current epoch
+  void mark_updated(const int64_t* slots, int64_t n) {
+    uint32_t e = epoch_.load(std::memory_order_relaxed);
+    for (int64_t i = 0; i < n; ++i) {
+      if (slots[i] >= 0 && slots[i] < capacity_)
+        version_[slots[i]].store(e, std::memory_order_relaxed);
+    }
+  }
+
+  int64_t alloc_slot() {
+    {
+      std::lock_guard<std::mutex> g(free_mu_);
+      if (!free_slots_.empty()) {
+        int64_t s = free_slots_.back();
+        free_slots_.pop_back();
+        return s;
+      }
+    }
+    int64_t s = next_slot_.fetch_add(1, std::memory_order_relaxed);
+    if (s >= capacity_) {
+      next_slot_.fetch_sub(1, std::memory_order_relaxed);
+      return -1;
+    }
+    return s;
+  }
+
+  int64_t size() {
+    int64_t total = 0;
+    for (auto& s : shards_) {
+      std::shared_lock<std::shared_mutex> rl(s.mu);
+      total += static_cast<int64_t>(s.map.size());
+    }
+    return total;
+  }
+
+  // Metadata-side growth; the caller resizes the device value table.
+  void grow(int64_t new_capacity) {
+    if (new_capacity <= capacity_) return;
+    // per-slot metadata: atomics are not movable — rebuild the arrays
+    std::unique_ptr<std::atomic<uint32_t>[]> nf(
+        new std::atomic<uint32_t>[new_capacity]);
+    std::unique_ptr<std::atomic<uint32_t>[]> nt(
+        new std::atomic<uint32_t>[new_capacity]);
+    std::unique_ptr<std::atomic<uint32_t>[]> nv(
+        new std::atomic<uint32_t>[new_capacity]);
+    for (int64_t i = 0; i < capacity_; ++i) {
+      nf[i].store(freq_[i].load(std::memory_order_relaxed));
+      nt[i].store(ts_[i].load(std::memory_order_relaxed));
+      nv[i].store(version_[i].load(std::memory_order_relaxed));
+    }
+    for (int64_t i = capacity_; i < new_capacity; ++i) {
+      nf[i].store(0); nt[i].store(0); nv[i].store(0);
+    }
+    freq_ = std::move(nf);
+    ts_ = std::move(nt);
+    version_ = std::move(nv);
+    slot_key_.resize(new_capacity, -1);
+    capacity_ = new_capacity;
+  }
+
+  // Remove keys last seen strictly before `ts_threshold`; recycles slots.
+  // Parity: KvVariableDeleteWithTimestamp (ops/kv_variable_ops.cc).
+  int64_t evict_older_than(uint32_t ts_threshold, int64_t* evicted_slots,
+                           int64_t max_out) {
+    int64_t count = 0;
+    for (auto& s : shards_) {
+      std::unique_lock<std::shared_mutex> wl(s.mu);
+      for (auto it = s.map.begin(); it != s.map.end();) {
+        int64_t slot = it->second;
+        if (ts_[slot].load(std::memory_order_relaxed) < ts_threshold) {
+          if (count < max_out) evicted_slots[count] = slot;
+          ++count;
+          slot_key_[slot] = -1;
+          freq_[slot].store(0, std::memory_order_relaxed);
+          {
+            std::lock_guard<std::mutex> g(free_mu_);
+            free_slots_.push_back(slot);
+          }
+          it = s.map.erase(it);
+        } else {
+          ++it;
+        }
+      }
+    }
+    return count;
+  }
+
+  // Full export: every (key, slot[, freq, ts]).  Returns count written
+  // (<= max_out); call with max_out=0 to size the buffers.
+  int64_t export_entries(int64_t* keys, int64_t* slots, uint32_t* freqs,
+                         uint32_t* tss, int64_t max_out) {
+    int64_t count = 0;
+    for (auto& s : shards_) {
+      std::shared_lock<std::shared_mutex> rl(s.mu);
+      for (auto& kv : s.map) {
+        if (count < max_out) {
+          keys[count] = kv.first;
+          slots[count] = kv.second;
+          if (freqs) freqs[count] = freq_[kv.second].load();
+          if (tss) tss[count] = ts_[kv.second].load();
+        }
+        ++count;
+      }
+    }
+    return count;
+  }
+
+  // Delta export: entries whose version >= since_epoch.
+  // Parity: KvVariableFullOrDeltaExport (ops/kv_variable_ops.cc:633).
+  int64_t export_delta(uint32_t since_epoch, int64_t* keys, int64_t* slots,
+                       int64_t max_out) {
+    int64_t count = 0;
+    for (auto& s : shards_) {
+      std::shared_lock<std::shared_mutex> rl(s.mu);
+      for (auto& kv : s.map) {
+        if (version_[kv.second].load(std::memory_order_relaxed) >=
+            since_epoch) {
+          if (count < max_out) {
+            keys[count] = kv.first;
+            slots[count] = kv.second;
+          }
+          ++count;
+        }
+      }
+    }
+    return count;
+  }
+
+  // Begin a new dirty-tracking epoch; returns the epoch that just closed.
+  uint32_t advance_epoch() {
+    return epoch_.fetch_add(1, std::memory_order_relaxed);
+  }
+
+  uint32_t current_epoch() { return epoch_.load(std::memory_order_relaxed); }
+
+  // Import (restore): pre-assigned (key, slot) pairs.  Caller holds
+  // global_mu_ exclusive (the free-list rebuild must not race alloc_slot).
+  int import_entries(const int64_t* keys, const int64_t* slots,
+                     const uint32_t* freqs, const uint32_t* tss, int64_t n) {
+    int64_t max_slot = -1;
+    for (int64_t i = 0; i < n; ++i) {
+      if (slots[i] >= capacity_) return -1;  // grow first
+      if (slots[i] > max_slot) max_slot = slots[i];
+      Shard& s = shard_for(keys[i]);
+      std::unique_lock<std::shared_mutex> wl(s.mu);
+      s.map[keys[i]] = slots[i];
+      slot_key_[slots[i]] = keys[i];
+      freq_[slots[i]].store(freqs ? freqs[i] : 1);
+      ts_[slots[i]].store(tss ? tss[i] : 0);
+      version_[slots[i]].store(0);
+    }
+    // slot allocator must not re-hand imported slots: bump the watermark
+    // AND drop them from the recycle list (an evicted slot may be re-
+    // introduced by a checkpoint import — leaving it in the free list would
+    // alias two keys onto one row)
+    int64_t cur = next_slot_.load();
+    while (cur <= max_slot &&
+           !next_slot_.compare_exchange_weak(cur, max_slot + 1)) {
+    }
+    {
+      std::lock_guard<std::mutex> g(free_mu_);
+      if (!free_slots_.empty()) {
+        std::unordered_set<int64_t> imported(slots, slots + n);
+        std::vector<int64_t> keep;
+        keep.reserve(free_slots_.size());
+        for (int64_t s : free_slots_) {
+          if (!imported.count(s)) keep.push_back(s);
+        }
+        free_slots_ = std::move(keep);
+      }
+    }
+    return 0;
+  }
+
+  void get_freq(const int64_t* slots, int64_t n, uint32_t* out) {
+    for (int64_t i = 0; i < n; ++i) {
+      out[i] = (slots[i] >= 0 && slots[i] < capacity_)
+                   ? freq_[slots[i]].load(std::memory_order_relaxed)
+                   : 0;
+    }
+  }
+
+  int64_t capacity() const { return capacity_; }
+
+  // grow() swaps the metadata arrays — every other operation holds this
+  // shared; grow (and import, which edits the free list wholesale) holds it
+  // exclusive.  Acquired at the C-ABI boundary, once per batch call.
+  std::shared_mutex& global_mu() { return global_mu_; }
+
+ private:
+  int64_t capacity_;
+  std::vector<Shard> shards_;
+  std::atomic<int64_t> next_slot_{0};
+  std::mutex free_mu_;
+  std::vector<int64_t> free_slots_;
+  std::unique_ptr<std::atomic<uint32_t>[]> freq_, ts_, version_;
+  std::vector<int64_t> slot_key_;
+  std::atomic<uint32_t> epoch_{1};
+  std::shared_mutex global_mu_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* kv_create(int64_t capacity, int num_shards) {
+  return new KvStore(capacity, num_shards);
+}
+
+void kv_destroy(void* h) { delete static_cast<KvStore*>(h); }
+
+// Batch insert-or-lookup.  Returns the index of the first UNPROCESSED key
+// (== n on success; < n when the table filled mid-batch — the caller grows
+// and resumes from that index, so already-processed keys are not re-touched
+// and frequency counts stay exact).  New-key count accumulates into
+// *n_new_out.
+int64_t kv_lookup_or_insert(void* h, const int64_t* keys, int64_t n,
+                            int64_t* slots_out, uint32_t now,
+                            int64_t* n_new_out) {
+  auto* st = static_cast<KvStore*>(h);
+  std::shared_lock<std::shared_mutex> g(st->global_mu());
+  for (int64_t i = 0; i < n; ++i) {
+    bool inserted = false;
+    int64_t slot = st->lookup_or_insert(keys[i], now, &inserted);
+    if (slot < 0) return i;
+    slots_out[i] = slot;
+    if (inserted && n_new_out) ++(*n_new_out);
+  }
+  return n;
+}
+
+void kv_lookup(void* h, const int64_t* keys, int64_t n, int64_t* slots_out) {
+  auto* st = static_cast<KvStore*>(h);
+  std::shared_lock<std::shared_mutex> g(st->global_mu());
+  for (int64_t i = 0; i < n; ++i) slots_out[i] = st->lookup(keys[i]);
+}
+
+int64_t kv_size(void* h) { return static_cast<KvStore*>(h)->size(); }
+int64_t kv_capacity(void* h) { return static_cast<KvStore*>(h)->capacity(); }
+void kv_grow(void* h, int64_t cap) {
+  auto* st = static_cast<KvStore*>(h);
+  std::unique_lock<std::shared_mutex> g(st->global_mu());
+  st->grow(cap);
+}
+
+int64_t kv_evict_older_than(void* h, uint32_t ts, int64_t* slots,
+                            int64_t max_out) {
+  auto* st = static_cast<KvStore*>(h);
+  std::shared_lock<std::shared_mutex> g(st->global_mu());
+  return st->evict_older_than(ts, slots, max_out);
+}
+
+int64_t kv_export(void* h, int64_t* keys, int64_t* slots, uint32_t* freqs,
+                  uint32_t* tss, int64_t max_out) {
+  auto* st = static_cast<KvStore*>(h);
+  std::shared_lock<std::shared_mutex> g(st->global_mu());
+  return st->export_entries(keys, slots, freqs, tss, max_out);
+}
+
+int64_t kv_export_delta(void* h, uint32_t since_epoch, int64_t* keys,
+                        int64_t* slots, int64_t max_out) {
+  auto* st = static_cast<KvStore*>(h);
+  std::shared_lock<std::shared_mutex> g(st->global_mu());
+  return st->export_delta(since_epoch, keys, slots, max_out);
+}
+
+uint32_t kv_advance_epoch(void* h) {
+  return static_cast<KvStore*>(h)->advance_epoch();
+}
+
+uint32_t kv_current_epoch(void* h) {
+  return static_cast<KvStore*>(h)->current_epoch();
+}
+
+int kv_import(void* h, const int64_t* keys, const int64_t* slots,
+              const uint32_t* freqs, const uint32_t* tss, int64_t n) {
+  auto* st = static_cast<KvStore*>(h);
+  std::unique_lock<std::shared_mutex> g(st->global_mu());
+  return st->import_entries(keys, slots, freqs, tss, n);
+}
+
+void kv_get_freq(void* h, const int64_t* slots, int64_t n, uint32_t* out) {
+  auto* st = static_cast<KvStore*>(h);
+  std::shared_lock<std::shared_mutex> g(st->global_mu());
+  st->get_freq(slots, n, out);
+}
+
+void kv_mark_updated(void* h, const int64_t* slots, int64_t n) {
+  auto* st = static_cast<KvStore*>(h);
+  std::shared_lock<std::shared_mutex> g(st->global_mu());
+  st->mark_updated(slots, n);
+}
+
+}  // extern "C"

@@ -1,0 +1,152 @@
+"""Group-sparse optimizers for the embedding value table.
+
+Parity: reference `tfplus/tfplus/kv_variable/kernels/training_ops.cc`
+(7,236 LoC of CPU kernels: Ftrl, GroupAdam, Adagrad, Momentum, ...) and the
+python classes `tfplus/tfplus/kv_variable/python/training/{group_adam,
+adagrad,sparse_group_ftrl,...}.py`.
+
+TPU redesign: each optimizer is ONE jitted function updating only the rows a
+step touched.  Duplicate ids in the batch are pre-reduced with a
+segment-sum onto unique slots (the batch's gradient rows arrive ragged; XLA
+`segment_sum` tiles it onto the VPU), then the row updates are dense
+(n_touched, dim) arithmetic scattered back with `.at[slots].set` — a static-
+shape scatter the compiler fuses.  Slot-state tables (m/v/accum/z/n) are
+(capacity, dim) arrays sharded like the value table, so the whole update
+runs under GSPMD with no host round-trip.
+
+Group semantics ("group_adam" / "sparse_group_ftrl"): the group lasso term
+applies per embedding row (the "group" is the whole row), zeroing rows whose
+accumulated magnitude falls under l21 regularization — matching the
+reference's group sparse training that prunes whole features.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class SparseOptConfig:
+    kind: str = "adam"  # adam | group_adam | adagrad | ftrl | sgd
+    lr: float = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    eps: float = 1e-8
+    # ftrl
+    lr_power: float = -0.5
+    l1: float = 0.0
+    l2: float = 0.0
+    # group lasso (row-wise l21) for group_adam / ftrl
+    l21: float = 0.0
+
+
+def init_slot_state(cfg: SparseOptConfig, capacity: int, dim: int,
+                    dtype=jnp.float32) -> Dict[str, Any]:
+    """Optimizer state tables matching the value table layout."""
+    zeros = lambda: jnp.zeros((capacity, dim), dtype)  # noqa: E731
+    if cfg.kind in ("adam", "group_adam"):
+        return {"m": zeros(), "v": zeros(),
+                "count": jnp.zeros((capacity, 1), jnp.int32)}
+    if cfg.kind == "adagrad":
+        return {"accum": zeros()}
+    if cfg.kind == "ftrl":
+        return {"accum": zeros(), "z": zeros()}
+    if cfg.kind == "sgd":
+        return {}
+    raise ValueError(f"unknown sparse optimizer {cfg.kind!r}")
+
+
+def dedup_grads(slots: jax.Array, grads: jax.Array, num_unique: int
+                ) -> Tuple[jax.Array, jax.Array]:
+    """Reduce duplicate-slot gradients: returns (unique_slots, summed_grads).
+
+    `num_unique` is a static bound (≤ len(slots)); surplus rows point at a
+    scratch slot index equal to the first unique slot with zero gradient, so
+    the scatter is a harmless += 0.
+    """
+    uniq, inv = jnp.unique(slots, return_inverse=True,
+                           size=num_unique, fill_value=-1)
+    summed = jax.ops.segment_sum(grads, inv.ravel(), num_segments=num_unique)
+    # fill_value slots (-1) would scatter OOB; point them at row 0 with g=0
+    valid = (uniq >= 0)[:, None]
+    summed = jnp.where(valid, summed, 0.0)
+    uniq = jnp.where(uniq >= 0, uniq, 0)
+    return uniq, summed
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("table",
+                                                             "state"))
+def apply_sparse_update(cfg: SparseOptConfig, table: jax.Array,
+                        state: Dict[str, jax.Array], slots: jax.Array,
+                        grads: jax.Array
+                        ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """One sparse step: update `table` rows at (deduped) `slots` by `grads`.
+
+    slots: (n,) unique int32/64 row ids (dedup with `dedup_grads` first when
+    a batch can repeat ids).  grads: (n, dim).
+    """
+    g = grads.astype(table.dtype)
+    rows = table[slots]
+
+    if cfg.kind in ("adam", "group_adam"):
+        m = state["m"][slots]
+        v = state["v"][slots]
+        cnt = state["count"][slots] + 1
+        m = cfg.beta1 * m + (1 - cfg.beta1) * g
+        v = cfg.beta2 * v + (1 - cfg.beta2) * (g * g)
+        # per-row bias correction by the row's own step count — sparse rows
+        # see far fewer updates than the global step (reference GroupAdam)
+        c = cnt.astype(table.dtype)
+        mhat = m / (1 - cfg.beta1 ** c)
+        vhat = v / (1 - cfg.beta2 ** c)
+        new_rows = rows - cfg.lr * mhat / (jnp.sqrt(vhat) + cfg.eps)
+        if cfg.kind == "group_adam" and cfg.l21 > 0:
+            # row-wise group lasso proximal step: shrink whole rows
+            norm = jnp.linalg.norm(new_rows, axis=-1, keepdims=True)
+            scale = jnp.maximum(0.0, 1.0 - cfg.lr * cfg.l21 /
+                                jnp.maximum(norm, 1e-12))
+            new_rows = new_rows * scale
+        table = table.at[slots].set(new_rows)
+        state = dict(state,
+                     m=state["m"].at[slots].set(m),
+                     v=state["v"].at[slots].set(v),
+                     count=state["count"].at[slots].set(cnt))
+        return table, state
+
+    if cfg.kind == "adagrad":
+        accum = state["accum"][slots] + g * g
+        new_rows = rows - cfg.lr * g / (jnp.sqrt(accum) + cfg.eps)
+        table = table.at[slots].set(new_rows)
+        return table, dict(state, accum=state["accum"].at[slots].set(accum))
+
+    if cfg.kind == "ftrl":
+        # sparse_group_ftrl (reference training/sparse_group_ftrl.py)
+        accum = state["accum"][slots]
+        z = state["z"][slots]
+        new_accum = accum + g * g
+        sigma = (new_accum ** (-cfg.lr_power) -
+                 accum ** (-cfg.lr_power)) / cfg.lr
+        z = z + g - sigma * rows
+        zn = jnp.abs(z)
+        base = jnp.where(zn > cfg.l1, jnp.sign(z) * cfg.l1 - z, 0.0)
+        denom = (new_accum ** (-cfg.lr_power)) / cfg.lr + 2 * cfg.l2
+        new_rows = base / denom
+        if cfg.l21 > 0:  # group sparsity: zero rows under the l21 ball
+            norm = jnp.linalg.norm(new_rows, axis=-1, keepdims=True)
+            scale = jnp.maximum(0.0, 1.0 - cfg.l21 /
+                                jnp.maximum(norm, 1e-12))
+            new_rows = new_rows * scale
+        table = table.at[slots].set(new_rows)
+        return table, dict(state,
+                           accum=state["accum"].at[slots].set(new_accum),
+                           z=state["z"].at[slots].set(z))
+
+    if cfg.kind == "sgd":
+        return table.at[slots].add(-cfg.lr * g), state
+
+    raise ValueError(f"unknown sparse optimizer {cfg.kind!r}")

@@ -1,0 +1,368 @@
+"""Sparse-embedding service tests (tfplus KvVariable parity axis).
+
+Mirrors reference `tfplus/py_ut/` op tests + `kernels/kv_variable_test.cc`:
+insert-or-default gather, frequency filtering, eviction, group sparse
+optimizers, full/delta export-import, and an end-to-end toy recommendation
+model with dynamic vocabulary growth and restore.
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_wuqiong_tpu.embedding import (
+    KvEmbedding,
+    SparseOptConfig,
+    apply_sparse_update,
+    create_kv_store,
+    dedup_grads,
+    init_slot_state,
+)
+from dlrover_wuqiong_tpu.embedding.kv_store import (
+    NativeKvStore,
+    PyKvStore,
+    _build_lib,
+)
+
+_HAS_NATIVE = _build_lib() is not None
+
+
+def _stores():
+    out = [PyKvStore(64)]
+    if _HAS_NATIVE:
+        out.append(NativeKvStore(64))
+    return out
+
+
+class TestKvStore:
+    @pytest.mark.parametrize("store", _stores(),
+                             ids=lambda s: type(s).__name__)
+    def test_insert_lookup_freq(self, store):
+        ids = np.array([10, 20, 10, 30], np.int64)
+        slots, n_new = store.lookup_or_insert(ids, now=100)
+        assert n_new == 3
+        assert slots[0] == slots[2]  # same id → same slot
+        assert len(set(slots.tolist())) == 3
+        assert len(store) == 3
+        # lookup-only does not insert
+        miss = store.lookup(np.array([999], np.int64))
+        assert miss[0] == -1
+        freq = store.freq(slots)
+        assert freq[0] == 2  # id 10 seen twice
+
+    @pytest.mark.parametrize("store", _stores(),
+                             ids=lambda s: type(s).__name__)
+    def test_eviction_recycles_slots(self, store):
+        ids = np.arange(5, dtype=np.int64)
+        slots, _ = store.lookup_or_insert(ids, now=100)
+        evicted = store.evict_older_than(200)
+        assert len(evicted) == 5
+        assert len(store) == 0
+        slots2, n_new = store.lookup_or_insert(
+            np.arange(100, 105, dtype=np.int64), now=300)
+        assert n_new == 5
+        assert set(slots2.tolist()) == set(slots.tolist())  # recycled
+
+    @pytest.mark.parametrize("store", _stores(),
+                             ids=lambda s: type(s).__name__)
+    def test_full_export_import(self, store):
+        ids = np.array([7, 8, 9], np.int64)
+        slots, _ = store.lookup_or_insert(ids, now=50)
+        keys, eslots, freqs, tss = store.export(with_meta=True)
+        order = np.argsort(keys)
+        np.testing.assert_array_equal(np.sort(keys), [7, 8, 9])
+        fresh = type(store)(64)
+        fresh.import_(keys, eslots, freqs, tss)
+        np.testing.assert_array_equal(fresh.lookup(ids), slots)
+        # allocator skips imported slots
+        s2, _ = fresh.lookup_or_insert(np.array([1000], np.int64))
+        assert s2[0] not in set(eslots.tolist())
+
+    @pytest.mark.parametrize("store", _stores(),
+                             ids=lambda s: type(s).__name__)
+    def test_delta_export_tracks_epoch(self, store):
+        store.lookup_or_insert(np.array([1, 2], np.int64))
+        epoch = store.epoch
+        k0, _ = store.export_delta(epoch)
+        assert set(k0.tolist()) == {1, 2}
+        store.advance_epoch()
+        # nothing touched since → empty delta
+        k1, _ = store.export_delta(store.epoch)
+        assert len(k1) == 0
+        store.lookup_or_insert(np.array([2, 3], np.int64))
+        k2, _ = store.export_delta(store.epoch)
+        assert set(k2.tolist()) == {2, 3}
+
+    @pytest.mark.skipif(not _HAS_NATIVE, reason="no g++/native lib")
+    def test_native_concurrent_inserts(self):
+        store = NativeKvStore(100_000)
+        errs = []
+
+        def worker(base):
+            try:
+                for i in range(20):
+                    ids = np.arange(base + i * 50, base + i * 50 + 50,
+                                    dtype=np.int64) % 5000
+                    store.lookup_or_insert(ids)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker, args=(b * 997,))
+                   for b in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        assert len(store) <= 5000
+        # every id maps to exactly one slot
+        ids = np.arange(5000, dtype=np.int64)
+        slots = store.lookup(ids)
+        seen = slots[slots >= 0]
+        assert len(np.unique(seen)) == len(seen)
+
+    @pytest.mark.skipif(not _HAS_NATIVE, reason="no g++/native lib")
+    def test_native_grow(self):
+        store = NativeKvStore(4)
+        store.lookup_or_insert(np.arange(4, dtype=np.int64))
+        with pytest.raises(MemoryError):
+            store.lookup_or_insert(np.array([99], np.int64))
+        store.grow(8)
+        slots, _ = store.lookup_or_insert(np.array([99], np.int64))
+        assert slots[0] == 4
+
+
+class TestSparseOptim:
+    def test_dedup_grads(self):
+        slots = jnp.array([3, 1, 3, 2], jnp.int32)
+        grads = jnp.ones((4, 2)) * jnp.arange(1.0, 5.0)[:, None]
+        uniq, summed = dedup_grads(slots, grads, 4)
+        lookup = {int(s): summed[i].tolist() for i, s in enumerate(uniq)}
+        assert lookup[3] == [4.0, 4.0]  # rows 1 + 3
+        assert lookup[1] == [2.0, 2.0]
+        assert lookup[2] == [4.0, 4.0]
+
+    def test_sparse_adam_matches_dense_adam(self):
+        """Rows updated every step must follow dense Adam exactly."""
+        import optax
+
+        cfg = SparseOptConfig(kind="adam", lr=0.1)
+        dim, cap = 4, 8
+        table = jnp.ones((cap, dim))
+        state = init_slot_state(cfg, cap, dim)
+        opt = optax.adam(0.1)
+        ref = jnp.ones((2, dim))
+        ref_state = opt.init(ref)
+        slots = jnp.array([1, 5], jnp.int32)
+        for step in range(5):
+            g = jnp.full((2, dim), 0.5) * (step + 1)
+            table, state = apply_sparse_update(cfg, table, state, slots, g)
+            updates, ref_state = opt.update(g, ref_state, ref)
+            ref = optax.apply_updates(ref, updates)
+        np.testing.assert_allclose(np.asarray(table[slots]),
+                                   np.asarray(ref), rtol=2e-5)
+        # untouched rows unchanged
+        np.testing.assert_array_equal(np.asarray(table[0]), np.ones(dim))
+
+    def test_group_adam_l21_prunes_rows(self):
+        cfg = SparseOptConfig(kind="group_adam", lr=0.5, l21=10.0)
+        table = jnp.full((4, 3), 0.01)
+        state = init_slot_state(cfg, 4, 3)
+        slots = jnp.array([2], jnp.int32)
+        g = jnp.full((1, 3), 1e-4)
+        table, state = apply_sparse_update(cfg, table, state, slots, g)
+        assert float(jnp.abs(table[2]).sum()) == 0.0  # whole row zeroed
+
+    @pytest.mark.parametrize("kind", ["adagrad", "ftrl", "sgd"])
+    def test_optimizers_step(self, kind):
+        cfg = SparseOptConfig(kind=kind, lr=0.1, l1=0.01, l2=0.01)
+        table = jnp.ones((6, 2))
+        state = init_slot_state(cfg, 6, 2)
+        slots = jnp.array([1, 4], jnp.int32)
+        g = jnp.ones((2, 2))
+        t2, _ = apply_sparse_update(cfg, table, state, slots, g)
+        assert not np.allclose(np.asarray(t2[slots]), 1.0)
+        np.testing.assert_array_equal(np.asarray(t2[0]), [1.0, 1.0])
+
+
+class TestKvEmbedding:
+    def test_insert_or_default_and_growth(self):
+        emb = KvEmbedding(dim=4, capacity=4, prefer_native=False)
+        ids = np.arange(100, 110, dtype=np.int64)
+        slots = emb.lookup_slots(ids)  # forces growth 4 → 16
+        assert emb.capacity >= 11
+        assert emb.vocab_size == 10
+        rows = emb.gather(slots)
+        assert rows.shape == (10, 4)
+        # same ids → same rows
+        slots2 = emb.lookup_slots(ids)
+        np.testing.assert_array_equal(slots, slots2)
+
+    def test_min_freq_filters_rare_ids(self):
+        emb = KvEmbedding(dim=2, capacity=16, min_freq=2,
+                          prefer_native=False)
+        s1 = emb.lookup_slots(np.array([42], np.int64))
+        assert s1[0] == 0  # first sight → null row
+        s2 = emb.lookup_slots(np.array([42], np.int64))
+        assert s2[0] != 0  # admitted at freq 2
+        np.testing.assert_array_equal(np.asarray(emb.gather(s1)),
+                                      np.zeros((1, 2)))
+
+    def test_gradients_update_only_touched_rows(self):
+        emb = KvEmbedding(dim=3, capacity=8,
+                          optimizer=SparseOptConfig(kind="sgd", lr=1.0),
+                          prefer_native=False)
+        slots = emb.lookup_slots(np.array([5, 6], np.int64))
+        before = np.asarray(emb.values).copy()
+        emb.apply_gradients(slots, np.ones((2, 3), np.float32))
+        after = np.asarray(emb.values)
+        np.testing.assert_allclose(after[slots], before[slots] - 1.0,
+                                   atol=1e-6)
+        untouched = [i for i in range(8) if i not in slots.tolist()]
+        np.testing.assert_array_equal(after[untouched], before[untouched])
+
+    def test_full_and_delta_checkpoint_roundtrip(self, tmp_path):
+        emb = KvEmbedding(dim=4, capacity=16, prefer_native=False,
+                          optimizer=SparseOptConfig(kind="adam", lr=0.1))
+        ids_a = np.array([1, 2, 3], np.int64)
+        slots_a = emb.lookup_slots(ids_a)
+        emb.apply_gradients(slots_a, np.ones((3, 4), np.float32))
+        emb.save(str(tmp_path), delta=False)  # full snapshot
+
+        ids_b = np.array([4, 5], np.int64)  # new ids after the full export
+        slots_b = emb.lookup_slots(ids_b)
+        emb.apply_gradients(slots_b, np.ones((2, 4), np.float32))
+        emb.save(str(tmp_path), delta=True)  # delta on top
+
+        fresh = KvEmbedding(dim=4, capacity=16, prefer_native=False,
+                            optimizer=SparseOptConfig(kind="adam", lr=0.1))
+        assert fresh.load(str(tmp_path))
+        all_ids = np.concatenate([ids_a, ids_b])
+        np.testing.assert_allclose(
+            np.asarray(fresh.gather(fresh.lookup_slots(all_ids,
+                                                       insert=False))),
+            np.asarray(emb.gather(emb.lookup_slots(all_ids, insert=False))),
+            atol=1e-6)
+        # optimizer state restored too: next identical step matches
+        emb.apply_gradients(slots_a, np.ones((3, 4), np.float32))
+        fs = fresh.lookup_slots(ids_a, insert=False)
+        fresh.apply_gradients(fs, np.ones((3, 4), np.float32))
+        np.testing.assert_allclose(np.asarray(fresh.gather(fs)),
+                                   np.asarray(emb.gather(slots_a)),
+                                   atol=1e-6)
+
+    def test_eviction_reinitializes_rows(self):
+        emb = KvEmbedding(dim=2, capacity=8, prefer_native=False)
+        slots = emb.lookup_slots(np.array([11], np.int64))
+        emb.apply_gradients(slots, np.full((1, 2), 5.0, np.float32))
+        trained = np.asarray(emb.gather(slots)).copy()
+        n = emb.evict_older_than(1 << 31)  # everything is older
+        assert n >= 1
+        slots2 = emb.lookup_slots(np.array([999], np.int64))
+        fresh_row = np.asarray(emb.gather(slots2))
+        assert not np.allclose(fresh_row, trained)
+
+
+class TestToyRecommendationModel:
+    """End-to-end: CTR-style two-feature model trained with dynamic vocab,
+    checkpointed (full + delta), restored, and verified convergent."""
+
+    def _step(self, emb_u, emb_i, uids, iids, labels):
+        us = emb_u.lookup_slots(uids)
+        is_ = emb_i.lookup_slots(iids)
+
+        def loss_fn(u_rows, i_rows):
+            logits = jnp.sum(u_rows * i_rows, axis=-1)
+            return jnp.mean(
+                jnp.maximum(logits, 0) - logits * labels +
+                jnp.log1p(jnp.exp(-jnp.abs(logits))))
+
+        u_rows = jnp.asarray(emb_u.gather(us))
+        i_rows = jnp.asarray(emb_i.gather(is_))
+        loss, (gu, gi) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+            u_rows, i_rows)
+        emb_u.apply_gradients(us, gu)
+        emb_i.apply_gradients(is_, gi)
+        return float(loss)
+
+    def test_train_grow_checkpoint_resume(self, tmp_path):
+        rng = np.random.default_rng(0)
+        opt = SparseOptConfig(kind="adam", lr=0.05)
+        emb_u = KvEmbedding(dim=8, capacity=8, optimizer=opt, seed=1,
+                            prefer_native=False)
+        emb_i = KvEmbedding(dim=8, capacity=8, optimizer=opt, seed=2,
+                            prefer_native=False)
+
+        losses = []
+        for step in range(30):
+            # vocabulary grows over time: later steps see new ids
+            hi = 10 + step * 2
+            uids = rng.integers(0, hi, 16).astype(np.int64)
+            iids = rng.integers(1000, 1000 + hi, 16).astype(np.int64)
+            labels = ((uids % 3) == (iids % 3)).astype(np.float32)
+            losses.append(self._step(emb_u, emb_i, uids, iids,
+                                     jnp.asarray(labels)))
+        assert np.mean(losses[-5:]) < np.mean(losses[:5])
+        assert emb_u.capacity > 8  # grew past the initial capacity
+
+        emb_u.save(str(tmp_path / "u"))
+        emb_i.save(str(tmp_path / "i"))
+
+        # restore and verify predictions match
+        ru = KvEmbedding(dim=8, capacity=8, optimizer=opt,
+                         prefer_native=False)
+        ri = KvEmbedding(dim=8, capacity=8, optimizer=opt,
+                         prefer_native=False)
+        assert ru.load(str(tmp_path / "u")) and ri.load(str(tmp_path / "i"))
+        uids = np.arange(10, dtype=np.int64)
+        iids = np.arange(1000, 1010, dtype=np.int64)
+        pred = lambda eu, ei: np.asarray(jnp.sum(  # noqa: E731
+            jnp.asarray(eu.gather(eu.lookup_slots(uids, insert=False))) *
+            jnp.asarray(ei.gather(ei.lookup_slots(iids, insert=False))),
+            axis=-1))
+        np.testing.assert_allclose(pred(ru, ri), pred(emb_u, emb_i),
+                                   atol=1e-5)
+
+
+class TestReviewInvariants:
+    def test_null_row_never_trains(self):
+        emb = KvEmbedding(dim=3, capacity=8, min_freq=2,
+                          optimizer=SparseOptConfig(kind="sgd", lr=1.0),
+                          prefer_native=False)
+        slots = emb.lookup_slots(np.array([77], np.int64))  # filtered → 0
+        assert slots[0] == 0
+        emb.apply_gradients(slots, np.ones((1, 3), np.float32))
+        np.testing.assert_array_equal(np.asarray(emb.values[0]),
+                                      np.zeros(3))
+
+    def test_eviction_preserves_null_row(self):
+        emb = KvEmbedding(dim=2, capacity=8, prefer_native=False)
+        emb.lookup_slots(np.array([5], np.int64))
+        emb.evict_older_than(1 << 31)  # sweeps everything incl. sentinel
+        assert emb.vocab_size == 0
+        # null row still zero, and a new id must NOT land on slot 0
+        s = emb.lookup_slots(np.array([123], np.int64))
+        assert s[0] != 0
+        np.testing.assert_array_equal(np.asarray(emb.values[0]), np.zeros(2))
+
+    def test_growth_does_not_double_count_freq(self):
+        emb = KvEmbedding(dim=2, capacity=3, min_freq=2,
+                          prefer_native=False)
+        # batch larger than capacity forces growth mid-batch; every id is
+        # seen exactly once → all must still be filtered (freq 1 < 2)
+        ids = np.arange(10, 20, dtype=np.int64)
+        slots = emb.lookup_slots(ids)
+        assert (slots == 0).all(), "single-sight ids must stay filtered"
+
+    def test_import_removes_slot_from_free_list(self):
+        store = create_kv_store(8, prefer_native=False)
+        slots, _ = store.lookup_or_insert(np.array([1, 2], np.int64))
+        store.evict_older_than(1 << 31)
+        # re-import key 1 at its old slot, then insert a fresh key: it must
+        # not be handed the imported slot
+        store.import_(np.array([1], np.int64), slots[:1])
+        s_new, _ = store.lookup_or_insert(np.array([99], np.int64))
+        assert s_new[0] != slots[0]
