@@ -95,6 +95,8 @@ class ElasticAgent:
         self._saver: Optional[AsyncCheckpointSaver] = None
         self._heartbeat_thread: Optional[threading.Thread] = None
         self._last_restart_ts = 0.0
+        self._replica_server = None
+        self._replica_manager = None
 
     # ------------------------------------------------------------- rendezvous
 
@@ -144,6 +146,53 @@ class ElasticAgent:
             self._saver = AsyncCheckpointSaver.start_async_saving_ckpt(
                 job_name=os.getenv(NodeEnv.JOB_NAME, "dwt"),
                 local_shard_num=1, node_rank=self.node_rank)
+            self._saver.metric_hook = lambda kind, s: \
+                self.mc.report_custom_metric(
+                    {f"dwt_ckpt_{kind}_seconds": s})
+
+    def _setup_replication(self, outcome: RendezvousOutcome):
+        """Ring replication of staged checkpoints over agent TCP (DCN).
+
+        Parity: flash_checkpoint/replica.py backup/gather — peer addresses
+        rendezvous through the master KV store; a replacement node restores
+        its staged segment from a peer before touching storage.
+        """
+        from ..common.global_context import get_context
+        from ..checkpoint.replica import CkptReplicaManager, ReplicaServer
+
+        replicas = get_context().checkpoint_replica
+        if replicas <= 0:
+            return
+        job = os.getenv(NodeEnv.JOB_NAME, "dwt")
+        if self._replica_server is None:
+            self._replica_server = ReplicaServer()
+            self._replica_server.start()
+        my_ip = os.getenv("DWT_NODE_IP", "127.0.0.1")
+        my_addr = f"{my_ip}:{self._replica_server.port}"
+        rdzv = outcome.rdzv_round
+        self.mc.kv_store_set(f"replica/{rdzv}/{outcome.process_id}",
+                             my_addr.encode())
+        peers = {}
+        keys = [f"replica/{rdzv}/{r}" for r in range(outcome.num_processes)]
+        if self.mc.kv_store_wait(keys, timeout=60.0):
+            vals = self.mc.kv_store_multi_get(keys) or []
+            for r, v in enumerate(vals):
+                if v:
+                    peers[r] = v.decode() if isinstance(v, bytes) else v
+        self._replica_manager = CkptReplicaManager(
+            rank=outcome.process_id, peers=peers, job_name=job,
+            replica_count=replicas)
+        if not self._replica_manager.has_local_segment():
+            # replacement node (or first boot after a node swap): the staged
+            # checkpoint exists only on a peer — pull it into local shm so
+            # the worker restores in-memory instead of re-reading storage.
+            # Gating on the MISSING local segment (not restart counts, which
+            # reset with the agent process) also guarantees we never
+            # clobber a newer local segment with a peer's older copy.
+            restored = self._replica_manager.restore()
+            if restored is not None:
+                logger.info("replica restore: staged step %d recovered "
+                            "from a peer", restored)
 
     def _launch_worker(self, outcome: RendezvousOutcome) -> WorkerContext:
         env = dict(os.environ)
@@ -236,6 +285,13 @@ class ElasticAgent:
 
                 self._saver._event_queue.put(CheckpointEvent.update_world(
                     outcome.num_processes, outcome.process_id))
+            try:
+                self._setup_replication(outcome)
+                if self._replica_manager is not None:
+                    self._saver.post_save_hook = \
+                        lambda step: self._replica_manager.backup()
+            except Exception:  # noqa: BLE001 — replication is best-effort
+                logger.exception("checkpoint replication setup failed")
             self._worker = self._launch_worker(outcome)
             exit_code = self._monitor_worker()
             if exit_code == 0:

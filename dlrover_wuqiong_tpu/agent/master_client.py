@@ -144,6 +144,11 @@ class MasterClient:
             node_id=self.node_id, node_type=self.node_type,
             event_type=event_type, message=message, level=level))
 
+    def report_custom_metric(self, data):
+        """Push {metric_name: value} to the master; dwt_* names land in the
+        master's exported metric registry."""
+        return self._client.report(msg.CustomMetric(data=dict(data)))
+
     def report_diagnosis(self, payload_type: str,
                          content: str) -> msg.DiagnosisAction:
         return self._client.report(msg.DiagnosisReport(

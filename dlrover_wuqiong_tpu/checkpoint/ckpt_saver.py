@@ -110,6 +110,12 @@ class AsyncCheckpointSaver:
         self._last_persisted_step = -1
         self._latest_shm_step = -1
         self._latest_path = ""
+        # invoked with the step after a successful persist — the agent hangs
+        # cross-node replica backup here (checkpoint/replica.py)
+        self.post_save_hook = None
+        # invoked with (kind, seconds) per persist — the agent forwards to
+        # the master's metric registry (the agent's own registry is local)
+        self.metric_hook = None
 
     # ---------------------------------------------------------------- factory
 
@@ -254,8 +260,29 @@ class AsyncCheckpointSaver:
             # checkpoint eligible for the teardown/failure flush retry
             self._last_persisted_step = step
             self._latest_path = path
+            elapsed = time.time() - start
             logger.info("persisted checkpoint step=%d to %s in %.2fs", step,
-                        sdir, time.time() - start)
+                        sdir, elapsed)
+            try:
+                from ..master.metrics import get_registry
+
+                get_registry().observe(
+                    "dwt_ckpt_seconds", elapsed,
+                    {"job": self.job_name, "kind": "persist"},
+                    help="checkpoint stage timings")
+            except Exception:  # noqa: BLE001 — metrics must never break IO
+                pass
+            if self.metric_hook is not None:
+                try:
+                    self.metric_hook("persist", elapsed)
+                except Exception:  # noqa: BLE001
+                    pass
+            if self.post_save_hook is not None:
+                try:
+                    self.post_save_hook(step)
+                except Exception:  # noqa: BLE001 — replication best-effort
+                    logger.exception("post-save hook failed for step %d",
+                                     step)
         else:
             logger.error("failed to persist checkpoint step=%d", step)
 

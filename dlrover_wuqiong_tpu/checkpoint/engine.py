@@ -187,7 +187,29 @@ class CheckpointEngine:
             target=self._drain, args=(snapshot, step, extra, storage_path),
             daemon=True, name="dwt-ckpt-drain")
         self._drain_thread.start()
-        return time.time() - t0
+        blocked = time.time() - t0
+        self._record_blocking_metric(blocked)
+        return blocked
+
+    def _record_blocking_metric(self, blocked: float):
+        """Local registry + forward to the master (whose /metrics endpoint
+        is the one operators scrape — the worker's registry is per-process
+        and unexported)."""
+        try:
+            from ..master.metrics import get_registry
+
+            get_registry().observe("dwt_ckpt_seconds", blocked,
+                                   {"job": self.job_name,
+                                    "kind": "blocking"},
+                                   help="checkpoint stage timings")
+            from ..trainer import elastic as _elastic
+
+            ctx = getattr(_elastic, "_context", None)
+            if ctx is not None and ctx.mc is not None:
+                ctx.mc.report_custom_metric(
+                    {"dwt_ckpt_blocking_seconds": blocked})
+        except Exception:  # noqa: BLE001 — metrics must never break saves
+            pass
 
     def save_to_memory(self, step: int, state: Any,
                        extra_meta: Optional[Dict] = None,

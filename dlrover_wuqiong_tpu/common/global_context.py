@@ -28,6 +28,8 @@ class Context:
     network_check: bool = False
     auto_tunning: bool = False
     checkpoint_replica: int = 0
+    # /metrics exporter port: -1 disables, 0 picks a free port
+    metrics_port: int = -1
     # paths
     work_dir: str = "/tmp/dwt"
     extra: dict = field(default_factory=dict)

@@ -193,9 +193,12 @@ class KvEmbedding:
         affordable when only a fraction of the vocabulary trains per
         interval.
         """
-        epoch = self.store.epoch
+        # close the epoch BEFORE scanning: a row touched concurrently with
+        # the scan gets the new epoch's version, so it lands in this delta,
+        # the next one, or both — never in neither (duplicates are
+        # idempotent on import; a missed row would be silent staleness)
+        epoch = self.store.advance_epoch()
         keys, slots = self.store.export_delta(epoch)
-        self.store.advance_epoch()
         out = {"keys": keys, "slots": slots,
                "values": np.asarray(self.values[slots]) if len(slots)
                else np.zeros((0, self.dim), np.float32),

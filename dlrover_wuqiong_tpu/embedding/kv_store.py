@@ -47,7 +47,9 @@ def _build_lib() -> Optional[ctypes.CDLL]:
                       os.path.join(tempfile.gettempdir(),
                                    f"dwt_libkvstore_{os.getuid()}.so")]
         for so in candidates:
-            if os.path.exists(so) and os.path.getmtime(so) >= \
+            # strictly newer: a checkout can give .so and .cc identical
+            # mtimes, which would load a binary one edit behind the source
+            if os.path.exists(so) and os.path.getmtime(so) > \
                     os.path.getmtime(_SRC):
                 try:
                     _LIB_CACHE = _load(so)

@@ -135,7 +135,11 @@ def apply_sparse_update(cfg: SparseOptConfig, table: jax.Array,
         zn = jnp.abs(z)
         base = jnp.where(zn > cfg.l1, jnp.sign(z) * cfg.l1 - z, 0.0)
         denom = (new_accum ** (-cfg.lr_power)) / cfg.lr + 2 * cfg.l2
-        new_rows = base / denom
+        # never-trained rows with zero gradient have denom == 0 (accum 0,
+        # l2 0): 0/0 would write NaN into e.g. the reserved null row via
+        # dedup padding — leave such rows untouched instead
+        denom_safe = jnp.where(denom > 0, denom, 1.0)
+        new_rows = jnp.where(denom > 0, base / denom_safe, rows)
         if cfg.l21 > 0:  # group sparsity: zero rows under the l21 ball
             norm = jnp.linalg.norm(new_rows, axis=-1, keepdims=True)
             scale = jnp.maximum(0.0, 1.0 - cfg.l21 /

@@ -233,6 +233,73 @@ class JobManager:
                        for n in self._nodes.values())
 
 
+class DistJobManager(JobManager):
+    """Platform-backed manager: scheduler client + scaler + watcher.
+
+    Parity: reference `DistributedJobManager` (`dist_job_manager.py:88`) —
+    `start` creates the initial scale plan (`_create_initial_scale_plan`
+    :242) and starts the watch/heartbeat threads (:334, :355); relaunch
+    decisions flow through the PodScaler instead of a noop.
+    """
+
+    def __init__(self, scheduler_client, num_workers: int = 1,
+                 spec_factory=None, max_relaunch_count: Optional[int] = None):
+        from ..scheduler.subprocess_scheduler import (
+            SubprocessSchedulerClient,
+        )
+        from .scaler import PodScaler, ScalePlan
+        from .watcher import PodWatcher
+
+        if spec_factory is None and isinstance(scheduler_client,
+                                               SubprocessSchedulerClient):
+            # the default spec has no command — every launch would fail
+            # through the retry queue and silently drop the node
+            raise ValueError(
+                "DistJobManager over the subprocess backend needs a "
+                "spec_factory that sets NodeSpec.command")
+        self._client = scheduler_client
+        scaler = PodScaler(scheduler_client, spec_factory=spec_factory)
+        super().__init__(scaler=scaler,
+                         max_relaunch_count=max_relaunch_count)
+        self._num_workers = num_workers
+        self._watcher = PodWatcher(scheduler_client, self.process_event)
+        self._heartbeat_thread: Optional[threading.Thread] = None
+        self._ScalePlan = ScalePlan
+
+    def start(self):
+        """Initial scale plan + watch/heartbeat monitors."""
+        plan = self._ScalePlan()
+        for i in range(self._num_workers):
+            node = self.register_node(NodeType.WORKER, i, rank_index=i)
+            node.update_status(NodeStatus.PENDING)
+            plan.launch_nodes.append(self._scaler.spec_for(node))
+        self._scaler.scale(plan)
+        self._watcher.start()
+        self._start_heartbeat_monitor()
+
+    def _start_heartbeat_monitor(self):
+        def _loop():
+            while not self._stopped.wait(
+                    get_context().node_heartbeat_interval):
+                for node in self.get_dead_nodes():
+                    logger.warning("node %s heartbeat timed out", node.id)
+                    ev = Node(node.type, node.id,
+                              rank_index=node.rank_index)
+                    ev.status = NodeStatus.FAILED
+                    ev.exit_reason = NodeExitReason.HANG
+                    self.process_event(NodeEvent(NodeEventType.MODIFIED,
+                                                 ev))
+
+        self._heartbeat_thread = threading.Thread(
+            target=_loop, daemon=True, name="dwt-heartbeat-monitor")
+        self._heartbeat_thread.start()
+
+    def stop(self):
+        self._stopped.set()
+        self._watcher.stop()
+        self._scaler.stop()
+
+
 class LocalJobManager(JobManager):
     """Single-node manager backing `--standalone` (parity local_job_manager.py)."""
 

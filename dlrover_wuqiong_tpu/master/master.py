@@ -62,6 +62,18 @@ class JobMaster:
         self._exit_code = 0
         self._exit_reason = ""
         self._stopped = threading.Event()
+        # observability: metric collector + optional /metrics endpoint
+        # (parity stats/job_collector.py + xpu_timer Prometheus export)
+        from .metrics import JobMetricCollector, PrometheusExporter
+
+        self.metric_collector = JobMetricCollector()
+        self._exporter: Optional[PrometheusExporter] = None
+        if ctx.metrics_port >= 0:
+            try:
+                self._exporter = PrometheusExporter(port=ctx.metrics_port)
+            except OSError:
+                logger.warning("metrics port %d unavailable",
+                               ctx.metrics_port)
 
     # --------------------------------------------------------------- service
 
@@ -76,11 +88,15 @@ class JobMaster:
     def prepare(self):
         self._server.start()
         self.diagnosis_manager.start(interval=60.0)
+        if self._exporter is not None:
+            self._exporter.start()
         logger.info("master ready on port %s", self.port)
 
     def stop(self):
         self._stopped.set()
         self.diagnosis_manager.stop()
+        if self._exporter is not None:
+            self._exporter.stop()
         self._server.stop()
 
     # --------------------------------------------------------------- hooks
@@ -94,6 +110,19 @@ class JobMaster:
 
     def collect_custom_data(self, payload):
         self._custom_metrics[type(payload).__name__] = payload
+        # CustomMetric entries named dwt_* flow into the exported registry —
+        # this is how worker/agent-side timings (ckpt blocking/persist)
+        # reach the master's /metrics endpoint
+        data = getattr(payload, "data", None)
+        if isinstance(data, dict):
+            for name, value in data.items():
+                if isinstance(name, str) and name.startswith("dwt_"):
+                    try:
+                        self.metric_collector.reg.observe(
+                            name, float(value),
+                            {"job": self.metric_collector.job})
+                    except (TypeError, ValueError):
+                        pass
 
     def record_node_event(self, event: msg.NodeEventReport):
         self._node_events.append(event)
@@ -112,6 +141,7 @@ class JobMaster:
         ctx = get_context()
         start = time.time()
         while not self._stopped.wait(poll_interval):
+            self._collect_metrics()
             if max_seconds and time.time() - start > max_seconds:
                 self._exit_reason = JobExitReason.UNCOMPLETED_TIMEOUT
                 self._exit_code = 1
@@ -146,6 +176,21 @@ class JobMaster:
         logger.info("master exiting: reason=%s code=%d", self._exit_reason,
                     self._exit_code)
         return self._exit_code
+
+    def _collect_metrics(self):
+        """Push job state into the registry each poll cycle."""
+        try:
+            self.metric_collector.collect_global_step(
+                self.speed_monitor.completed_global_step)
+            self.metric_collector.collect_speed(
+                self.speed_monitor.running_speed())
+            for node in self.job_manager.all_nodes():
+                if node.used_resource.cpu or node.used_resource.memory_mb:
+                    self.metric_collector.collect_node_resource(
+                        node.id, node.used_resource.cpu,
+                        node.used_resource.memory_mb)
+        except Exception:  # noqa: BLE001 — metrics must never kill the loop
+            pass
 
     @property
     def exit_reason(self) -> str:

@@ -1,0 +1,130 @@
+"""ScalePlan + PodScaler: apply scale decisions to the platform.
+
+Parity: reference `master/scaler/base_scaler.py` (ScalePlan),
+`master/scaler/pod_scaler.py:77` (`PodScaler`, `_periodic_create_pod` :372,
+`_create_pod` :399 — a retry queue so transient platform errors don't drop
+nodes), and `scaler/elasticjob_scaler.py` (CRD-patching variant is the k8s
+backend's concern here).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..common.log import get_logger
+from ..common.node import Node
+from ..scheduler.base import NodeSpec, SchedulerClient
+from .job_manager import Scaler
+
+logger = get_logger("scaler")
+
+
+@dataclasses.dataclass
+class ScalePlan:
+    """A batch scale decision (parity base_scaler.py ScalePlan)."""
+
+    launch_nodes: List[NodeSpec] = dataclasses.field(default_factory=list)
+    remove_nodes: List[Node] = dataclasses.field(default_factory=list)
+    # desired replica count per node type ("" = unchanged)
+    node_group_replicas: Dict[str, int] = dataclasses.field(
+        default_factory=dict)
+
+    def empty(self) -> bool:
+        return not (self.launch_nodes or self.remove_nodes
+                    or self.node_group_replicas)
+
+
+class PodScaler(Scaler):
+    """Drives a SchedulerClient; failed creates go to a retry queue.
+
+    Works identically over the fake, subprocess, and k8s backends — the
+    platform difference lives entirely in the client.
+    """
+
+    def __init__(self, client: SchedulerClient,
+                 spec_factory=None, retry_interval: float = 3.0,
+                 max_create_retries: int = 5):
+        self._client = client
+        # node -> NodeSpec (command/env/image); default carries resources only
+        self._spec_factory = spec_factory or self._default_spec
+        self._retry_q: "queue.Queue[tuple]" = queue.Queue()
+        self._retry_interval = retry_interval
+        self._max_retries = max_create_retries
+        self._stopped = threading.Event()
+        self._retry_thread: Optional[threading.Thread] = None
+
+    @staticmethod
+    def _default_spec(node: Node) -> NodeSpec:
+        return NodeSpec(node_type=node.type, node_id=node.id,
+                        rank_index=node.rank_index or 0,
+                        resource=node.config_resource,
+                        relaunch_count=node.relaunch_count)
+
+    def spec_for(self, node: Node) -> NodeSpec:
+        return self._spec_factory(node)
+
+    # ----------------------------------------------------------------- plan
+
+    def scale(self, plan: ScalePlan):
+        """Parity: PodScaler.scale (pod_scaler.py:163)."""
+        for node in plan.remove_nodes:
+            self._delete(node)
+        for spec in plan.launch_nodes:
+            self._create(spec, attempt=0)
+
+    def scale_up(self, node: Node):
+        self._create(self._spec_factory(node), attempt=0)
+
+    def scale_down(self, node: Node):
+        self._delete(node)
+
+    # ------------------------------------------------------------- internals
+
+    def _create(self, spec: NodeSpec, attempt: int):
+        ok = False
+        try:
+            ok = self._client.create_node(spec)
+        except Exception:  # noqa: BLE001
+            logger.exception("create_node raised for %s-%d",
+                             spec.node_type, spec.node_id)
+        if not ok:
+            if attempt + 1 >= self._max_retries:
+                logger.error("giving up creating %s-%d after %d attempts",
+                             spec.node_type, spec.node_id, attempt + 1)
+                return
+            self._ensure_retry_thread()
+            self._retry_q.put((time.time() + self._retry_interval, spec,
+                               attempt + 1))
+
+    def _delete(self, node: Node):
+        try:
+            self._client.delete_node(node.type, node.id)
+        except Exception:  # noqa: BLE001
+            logger.exception("delete_node raised for %s", node)
+
+    def _ensure_retry_thread(self):
+        if self._retry_thread is None or not self._retry_thread.is_alive():
+            self._retry_thread = threading.Thread(
+                target=self._retry_loop, daemon=True,
+                name="dwt-pod-scaler-retry")
+            self._retry_thread.start()
+
+    def _retry_loop(self):
+        """Parity: `_periodic_create_pod` pod_scaler.py:372."""
+        while not self._stopped.is_set():
+            try:
+                due, spec, attempt = self._retry_q.get(timeout=1.0)
+            except queue.Empty:
+                continue
+            delay = due - time.time()
+            if delay > 0:
+                if self._stopped.wait(delay):
+                    return
+            self._create(spec, attempt)
+
+    def stop(self):
+        self._stopped.set()

@@ -1,0 +1,56 @@
+"""PodWatcher: platform event stream → JobManager state machine.
+
+Parity: reference `master/watcher/k8s_watcher.py` (`PodWatcher` list+watch →
+NodeEvent) and the `_monitor_nodes` thread (`dist_job_manager.py:334`) that
+pumps those events through `_process_event`.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from ..common.log import get_logger
+from ..common.node import NodeEvent
+from ..scheduler.base import SchedulerClient
+
+logger = get_logger("watcher")
+
+
+class PodWatcher:
+    """Background thread: client.watch() events → handler (JobManager)."""
+
+    def __init__(self, client: SchedulerClient,
+                 handler: Callable[[NodeEvent], None],
+                 poll_timeout: float = 1.0):
+        self._client = client
+        self._handler = handler
+        self._poll_timeout = poll_timeout
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="dwt-pod-watcher")
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stopped.is_set():
+            try:
+                for event in self._client.watch(self._poll_timeout):
+                    if self._stopped.is_set():
+                        return
+                    try:
+                        self._handler(event)
+                    except Exception:  # noqa: BLE001
+                        logger.exception("event handler failed for %s",
+                                         event)
+            except Exception:  # noqa: BLE001 — watch stream broke; reopen
+                logger.exception("watch stream error — reopening")
+                if self._stopped.wait(1.0):
+                    return
+
+    def stop(self, timeout: float = 5.0):
+        self._stopped.set()
+        if self._thread is not None:
+            self._thread.join(timeout)

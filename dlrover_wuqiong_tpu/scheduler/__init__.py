@@ -1,0 +1,26 @@
+"""Platform scheduler abstraction (L1 of the layer map, SURVEY.md §1).
+
+Parity: reference `dlrover/python/scheduler/` — `k8sClient`
+(`scheduler/kubernetes.py:121`, pod/service CRUD + watch), the local-process
+scheduler, and `JobArgs` (`scheduler/job.py:117`).
+
+One interface, three backends:
+  FakeSchedulerClient        — in-memory; unit tests drive events by hand
+  SubprocessSchedulerClient  — a "pod" is a local process (TPU-VM
+                               single-host jobs, CI, `--standalone`)
+  K8sSchedulerClient         — real kubernetes pods (GKE TPU slices); the
+                               `kubernetes` package is imported lazily so
+                               the rest of the stack never depends on it
+"""
+
+from .base import NodeSpec, SchedulerClient, new_scheduler_client
+from .fake import FakeSchedulerClient
+from .subprocess_scheduler import SubprocessSchedulerClient
+
+__all__ = [
+    "NodeSpec",
+    "SchedulerClient",
+    "new_scheduler_client",
+    "FakeSchedulerClient",
+    "SubprocessSchedulerClient",
+]

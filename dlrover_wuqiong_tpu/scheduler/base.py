@@ -1,0 +1,70 @@
+"""Scheduler interface + node spec.
+
+Parity: reference `scheduler/kubernetes.py:121` (k8sClient CRUD surface) and
+`master/watcher/k8s_watcher.py` (list/watch → NodeEvent stream), collapsed
+into one backend-agnostic client interface the master's scaler/watcher pair
+programs against.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterator, List, Optional
+
+from ..common.node import Node, NodeEvent, NodeResource
+
+
+@dataclasses.dataclass
+class NodeSpec:
+    """What to launch: the platform-agnostic pod/process description."""
+
+    node_type: str  # NodeType.*
+    node_id: int
+    rank_index: int = 0
+    resource: NodeResource = dataclasses.field(default_factory=NodeResource)
+    env: Dict[str, str] = dataclasses.field(default_factory=dict)
+    command: Optional[List[str]] = None  # subprocess backend
+    image: str = ""  # k8s backend
+    relaunch_count: int = 0
+
+    def name(self, job_name: str) -> str:
+        return f"{job_name}-{self.node_type}-{self.node_id}"
+
+
+class SchedulerClient:
+    """Backend interface. All methods are synchronous and idempotent."""
+
+    def create_node(self, spec: NodeSpec) -> bool:
+        raise NotImplementedError
+
+    def delete_node(self, node_type: str, node_id: int) -> bool:
+        raise NotImplementedError
+
+    def list_nodes(self) -> List[Node]:
+        raise NotImplementedError
+
+    def watch(self, timeout: float = 1.0) -> Iterator[NodeEvent]:
+        """Yield node events; returns when no event arrives within
+        `timeout` (the watcher loop re-calls)."""
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+
+def new_scheduler_client(platform: str, **kwargs) -> SchedulerClient:
+    """Factory (parity: reference `new_job_args` scheduler/factory.py)."""
+    if platform in ("fake", "test"):
+        from .fake import FakeSchedulerClient
+
+        return FakeSchedulerClient(**kwargs)
+    if platform in ("local", "subprocess"):
+        from .subprocess_scheduler import SubprocessSchedulerClient
+
+        return SubprocessSchedulerClient(**kwargs)
+    if platform in ("k8s", "kubernetes"):
+        from .k8s import K8sSchedulerClient
+
+        return K8sSchedulerClient(**kwargs)
+    raise ValueError(f"unknown platform {platform!r} "
+                     "(expected fake|local|k8s)")

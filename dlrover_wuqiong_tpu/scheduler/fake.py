@@ -1,0 +1,78 @@
+"""In-memory scheduler backend for tests.
+
+Parity: the reference tests' `mock_k8s_client` pattern
+(`dlrover/python/tests/test_utils.py:268-284` — monkey-patched CRUD with
+canned pod lists); here it is a first-class backend instead of a patch, so
+the same scaler/watcher code runs in unit tests unchanged.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Dict, Iterator, List, Tuple
+
+from ..common.constants import NodeEventType, NodeStatus
+from ..common.node import Node, NodeEvent
+from .base import NodeSpec, SchedulerClient
+
+
+class FakeSchedulerClient(SchedulerClient):
+    def __init__(self, fail_creates: int = 0):
+        self._nodes: Dict[Tuple[str, int], Node] = {}
+        self._events: "queue.Queue[NodeEvent]" = queue.Queue()
+        self._lock = threading.Lock()
+        self.create_calls: List[NodeSpec] = []
+        self.delete_calls: List[Tuple[str, int]] = []
+        self._fail_creates = fail_creates  # simulate platform flake
+
+    # ------------------------------------------------------------- interface
+
+    def create_node(self, spec: NodeSpec) -> bool:
+        with self._lock:
+            self.create_calls.append(spec)
+            if self._fail_creates > 0:
+                self._fail_creates -= 1
+                return False
+            node = Node(spec.node_type, spec.node_id,
+                        rank_index=spec.rank_index,
+                        config_resource=spec.resource)
+            node.status = NodeStatus.PENDING
+            node.create_time = time.time()
+            self._nodes[(spec.node_type, spec.node_id)] = node
+        self._events.put(NodeEvent(NodeEventType.ADDED, node))
+        return True
+
+    def delete_node(self, node_type: str, node_id: int) -> bool:
+        with self._lock:
+            self.delete_calls.append((node_type, node_id))
+            node = self._nodes.pop((node_type, node_id), None)
+        if node is not None:
+            node.status = NodeStatus.DELETED
+            self._events.put(NodeEvent(NodeEventType.DELETED, node))
+        return node is not None
+
+    def list_nodes(self) -> List[Node]:
+        with self._lock:
+            return list(self._nodes.values())
+
+    def watch(self, timeout: float = 1.0) -> Iterator[NodeEvent]:
+        while True:
+            try:
+                yield self._events.get(timeout=timeout)
+            except queue.Empty:
+                return
+
+    # ----------------------------------------------------------- test drives
+
+    def set_node_status(self, node_type: str, node_id: int, status: str,
+                        exit_reason: str = ""):
+        """Simulate the platform reporting a phase change."""
+        with self._lock:
+            node = self._nodes.get((node_type, node_id))
+            if node is None:
+                return
+            node.status = status
+            node.exit_reason = exit_reason
+        self._events.put(NodeEvent(NodeEventType.MODIFIED, node))

@@ -1,0 +1,152 @@
+"""Kubernetes scheduler backend.
+
+Parity: reference `scheduler/kubernetes.py:121` (`k8sClient` — pod CRUD,
+watch streams, singleton client) and the pod template handling in
+`master/scaler/pod_scaler.py:399` (`_create_pod`).
+
+The `kubernetes` package is imported lazily: environments without it (unit
+tests, single-host TPU-VMs) never touch this module.  Pod phase → NodeStatus
+mapping follows the reference's `master/watcher/k8s_watcher.py`.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterator, List, Optional
+
+from ..common.constants import NodeEventType, NodeStatus
+from ..common.log import get_logger
+from ..common.node import Node, NodeEvent, NodeResource
+from .base import NodeSpec, SchedulerClient
+
+logger = get_logger("k8s_scheduler")
+
+_POD_PHASE_TO_STATUS = {
+    "Pending": NodeStatus.PENDING,
+    "Running": NodeStatus.RUNNING,
+    "Succeeded": NodeStatus.SUCCEEDED,
+    "Failed": NodeStatus.FAILED,
+    "Unknown": NodeStatus.BREAKDOWN,
+}
+
+_LABEL_TYPE = "dwt.ai/node-type"
+_LABEL_ID = "dwt.ai/node-id"
+_LABEL_RANK = "dwt.ai/rank-index"
+_LABEL_JOB = "dwt.ai/job-name"
+
+
+class K8sSchedulerClient(SchedulerClient):
+    def __init__(self, namespace: str = "default", job_name: str = "dwt",
+                 image: str = "", master_addr: str = ""):
+        try:
+            from kubernetes import client, config, watch  # type: ignore
+        except ImportError as e:  # pragma: no cover - env without k8s
+            raise RuntimeError(
+                "K8sSchedulerClient needs the `kubernetes` package; use "
+                "platform='local' on hosts without it") from e
+        try:
+            config.load_incluster_config()
+        except Exception:  # noqa: BLE001 - outside a cluster
+            config.load_kube_config()
+        self._core = client.CoreV1Api()
+        self._client = client
+        self._watch_mod = watch
+        self.namespace = namespace
+        self.job_name = job_name
+        self.image = image
+        self.master_addr = master_addr
+        self._lock = threading.Lock()
+
+    # -------------------------------------------------------------- pod CRUD
+
+    def _pod_manifest(self, spec: NodeSpec):
+        c = self._client
+        env = [c.V1EnvVar(name=k, value=v) for k, v in spec.env.items()]
+        if self.master_addr:
+            env.append(c.V1EnvVar(name="DWT_MASTER_ADDR",
+                                  value=self.master_addr))
+        resources = {}
+        if spec.resource.cpu:
+            resources["cpu"] = str(spec.resource.cpu)
+        if spec.resource.memory_mb:
+            resources["memory"] = f"{int(spec.resource.memory_mb)}Mi"
+        container = c.V1Container(
+            name="main", image=spec.image or self.image,
+            command=spec.command, env=env,
+            resources=c.V1ResourceRequirements(
+                requests=resources or None, limits=resources or None))
+        return c.V1Pod(
+            metadata=c.V1ObjectMeta(
+                name=spec.name(self.job_name),
+                labels={
+                    _LABEL_JOB: self.job_name,
+                    _LABEL_TYPE: spec.node_type,
+                    _LABEL_ID: str(spec.node_id),
+                    _LABEL_RANK: str(spec.rank_index),
+                }),
+            spec=c.V1PodSpec(containers=[container],
+                             restart_policy="Never"))
+
+    def create_node(self, spec: NodeSpec) -> bool:
+        try:
+            self._core.create_namespaced_pod(self.namespace,
+                                             self._pod_manifest(spec))
+            return True
+        except Exception:  # noqa: BLE001
+            logger.exception("pod create failed: %s",
+                             spec.name(self.job_name))
+            return False
+
+    def delete_node(self, node_type: str, node_id: int) -> bool:
+        name = f"{self.job_name}-{node_type}-{node_id}"
+        try:
+            self._core.delete_namespaced_pod(name, self.namespace)
+            return True
+        except Exception:  # noqa: BLE001
+            logger.exception("pod delete failed: %s", name)
+            return False
+
+    # ------------------------------------------------------------ list/watch
+
+    def _pod_to_node(self, pod) -> Optional[Node]:
+        labels = pod.metadata.labels or {}
+        if labels.get(_LABEL_JOB) != self.job_name:
+            return None
+        try:
+            node = Node(labels[_LABEL_TYPE], int(labels[_LABEL_ID]),
+                        rank_index=int(labels.get(_LABEL_RANK, 0)),
+                        config_resource=NodeResource())
+        except (KeyError, ValueError):
+            return None
+        node.status = _POD_PHASE_TO_STATUS.get(
+            getattr(pod.status, "phase", "Unknown"), NodeStatus.BREAKDOWN)
+        statuses = getattr(pod.status, "container_statuses", None) or []
+        for cs in statuses:
+            term = getattr(cs.state, "terminated", None)
+            if term is not None and term.exit_code not in (0, None):
+                node.exit_reason = (
+                    "oom" if term.reason == "OOMKilled"
+                    else f"exit_code={term.exit_code}")
+        return node
+
+    def list_nodes(self) -> List[Node]:
+        pods = self._core.list_namespaced_pod(
+            self.namespace, label_selector=f"{_LABEL_JOB}={self.job_name}")
+        nodes = [self._pod_to_node(p) for p in pods.items]
+        return [n for n in nodes if n is not None]
+
+    def watch(self, timeout: float = 1.0) -> Iterator[NodeEvent]:
+        w = self._watch_mod.Watch()
+        stream = w.stream(
+            self._core.list_namespaced_pod, self.namespace,
+            label_selector=f"{_LABEL_JOB}={self.job_name}",
+            timeout_seconds=max(1, int(timeout)))
+        for event in stream:
+            node = self._pod_to_node(event["object"])
+            if node is None:
+                continue
+            etype = {"ADDED": NodeEventType.ADDED,
+                     "MODIFIED": NodeEventType.MODIFIED,
+                     "DELETED": NodeEventType.DELETED}.get(
+                         event["type"], NodeEventType.MODIFIED)
+            yield NodeEvent(etype, node)

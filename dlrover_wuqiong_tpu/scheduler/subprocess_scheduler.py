@@ -1,0 +1,142 @@
+"""Subprocess scheduler backend: a "pod" is a local process.
+
+Parity: the reference's local-process platform backing `--standalone`
+(LocalJobMaster) — here generalized so the SAME PodScaler/PodWatcher code
+path that drives k8s also drives single-host TPU-VM jobs: the master
+relaunch decision exercises real process creation instead of a noop.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import signal
+import subprocess
+import threading
+import time
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ..common.constants import NodeEventType, NodeStatus
+from ..common.log import get_logger
+from ..common.node import Node, NodeEvent
+from .base import NodeSpec, SchedulerClient
+
+logger = get_logger("subprocess_scheduler")
+
+
+class SubprocessSchedulerClient(SchedulerClient):
+    def __init__(self, log_dir: Optional[str] = None):
+        self._procs: Dict[Tuple[str, int], subprocess.Popen] = {}
+        self._nodes: Dict[Tuple[str, int], Node] = {}
+        self._specs: Dict[Tuple[str, int], NodeSpec] = {}
+        self._lock = threading.Lock()
+        self._log_dir = log_dir
+        self._events: "queue.Queue[NodeEvent]" = queue.Queue()
+
+    def create_node(self, spec: NodeSpec) -> bool:
+        if not spec.command:
+            raise ValueError("subprocess backend needs spec.command")
+        env = dict(os.environ)
+        env.update(spec.env)
+        stdout = None
+        if self._log_dir:
+            os.makedirs(self._log_dir, exist_ok=True)
+            stdout = open(os.path.join(
+                self._log_dir,
+                f"{spec.node_type}-{spec.node_id}.log"), "ab")
+        try:
+            proc = subprocess.Popen(spec.command, env=env, stdout=stdout,
+                                    stderr=subprocess.STDOUT
+                                    if stdout else None,
+                                    start_new_session=True)
+        except OSError as e:
+            logger.error("failed to launch %s: %s", spec.command, e)
+            return False
+        node = Node(spec.node_type, spec.node_id,
+                    rank_index=spec.rank_index,
+                    config_resource=spec.resource)
+        node.status = NodeStatus.RUNNING
+        node.create_time = time.time()
+        with self._lock:
+            self._procs[(spec.node_type, spec.node_id)] = proc
+            self._nodes[(spec.node_type, spec.node_id)] = node
+            self._specs[(spec.node_type, spec.node_id)] = spec
+        # surface the launch as an event (a process is RUNNING the moment it
+        # exists — the state machine needs the INITIAL→RUNNING hop before a
+        # terminal status can land)
+        self._events.put(NodeEvent(NodeEventType.ADDED, node))
+        logger.info("launched %s-%d pid=%d", spec.node_type, spec.node_id,
+                    proc.pid)
+        return True
+
+    def delete_node(self, node_type: str, node_id: int) -> bool:
+        with self._lock:
+            proc = self._procs.get((node_type, node_id))
+        if proc is None:
+            return False
+        if proc.poll() is None:
+            try:
+                os.killpg(proc.pid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+        with self._lock:
+            self._procs.pop((node_type, node_id), None)
+            node = self._nodes.pop((node_type, node_id), None)
+            self._specs.pop((node_type, node_id), None)
+        if node is not None:
+            node.status = NodeStatus.DELETED
+        return True
+
+    def list_nodes(self) -> List[Node]:
+        self._poll()
+        with self._lock:
+            return list(self._nodes.values())
+
+    def watch(self, timeout: float = 1.0) -> Iterator[NodeEvent]:
+        """Launch events + process-exit polling."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            got = False
+            try:
+                while True:
+                    yield self._events.get_nowait()
+                    got = True
+            except queue.Empty:
+                pass
+            events = self._poll()
+            for e in events:
+                yield e
+            if events or got:
+                deadline = time.time() + timeout
+            else:
+                time.sleep(0.05)
+
+    def _poll(self) -> List[NodeEvent]:
+        events = []
+        with self._lock:
+            for key, proc in list(self._procs.items()):
+                code = proc.poll()
+                if code is None:
+                    continue
+                node = self._nodes[key]
+                if node.status in (NodeStatus.SUCCEEDED, NodeStatus.FAILED):
+                    continue
+                node.status = (NodeStatus.SUCCEEDED if code == 0
+                               else NodeStatus.FAILED)
+                if code != 0:
+                    node.exit_reason = f"exit_code={code}"
+                events.append(NodeEvent(NodeEventType.MODIFIED, node))
+        return events
+
+    def close(self):
+        with self._lock:
+            keys = list(self._procs)
+        for node_type, node_id in keys:
+            self.delete_node(node_type, node_id)

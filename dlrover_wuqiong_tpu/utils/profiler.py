@@ -1,0 +1,97 @@
+"""Training-side profiling orchestration.
+
+Parity: reference `atorch/atorch/utils/prof.py` (torch.profiler window
+orchestration + timeline dump) and the xpu_timer runtime-timing intent
+(`atorch/dev/xpu_timer/common/manager.cc` — always-on step timings exported
+to Prometheus).
+
+TPU redesign: heavyweight tracing is `jax.profiler` (XPlane/TensorBoard
+format) started for a bounded step window; lightweight always-on timing is
+a host-side per-step stopwatch feeding the shared MetricRegistry (the
+device timeline inside a jit step is XLA's domain — per-op host hooks like
+LD_PRELOAD shims don't exist on TPU, the trace viewer covers that instead).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional
+
+from ..common.log import get_logger
+
+logger = get_logger("profiler")
+
+
+class StepProfiler:
+    """Windowed jax.profiler trace + always-on step timing.
+
+    Usage:
+        prof = StepProfiler(trace_dir="/tmp/trace", start_step=10,
+                            end_step=12)
+        for step in ...:
+            with prof.step(step):
+                state, m = train_step(state, batch)
+    """
+
+    def __init__(self, trace_dir: Optional[str] = None,
+                 start_step: int = -1, end_step: int = -1,
+                 registry=None, job_name: str = "dwt"):
+        self.trace_dir = trace_dir
+        self.start_step = start_step
+        self.end_step = end_step
+        self._tracing = False
+        self._job = job_name
+        if registry is None:
+            from ..master.metrics import get_registry
+
+            registry = get_registry()
+        self._reg = registry
+
+    @contextlib.contextmanager
+    def step(self, step: int):
+        self._maybe_start_trace(step)
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self._reg.observe("dwt_train_step_seconds", dt,
+                              {"job": self._job},
+                              help="host-observed train step wall time")
+            self._reg.gauge("dwt_train_last_step", step, {"job": self._job})
+            self._maybe_stop_trace(step)
+
+    def _maybe_start_trace(self, step: int):
+        if (self.trace_dir and not self._tracing
+                and step == self.start_step):
+            import jax
+
+            jax.profiler.start_trace(self.trace_dir)
+            self._tracing = True
+            logger.info("jax.profiler trace started at step %d → %s",
+                        step, self.trace_dir)
+
+    def _maybe_stop_trace(self, step: int):
+        if self._tracing and step >= self.end_step:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._tracing = False
+            logger.info("jax.profiler trace stopped at step %d", step)
+
+    def close(self):
+        if self._tracing:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._tracing = False
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Named region in the device trace (TraceAnnotation)."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield

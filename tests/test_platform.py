@@ -1,0 +1,240 @@
+"""Platform layer tests: scheduler backends, PodScaler/PodWatcher,
+DistJobManager relaunch, resource optimizer, JobAutoScaler.
+
+Mirrors reference `dlrover/python/tests/test_pod_scaler.py` /
+`test_job_manager.py` style: real master objects over a fake platform.
+"""
+
+import sys
+import time
+
+import pytest
+
+from dlrover_wuqiong_tpu.common.constants import (
+    NodeExitReason,
+    NodeStatus,
+    NodeType,
+)
+from dlrover_wuqiong_tpu.common.node import Node, NodeResource
+from dlrover_wuqiong_tpu.master.job_manager import DistJobManager
+from dlrover_wuqiong_tpu.master.resource_optimizer import (
+    JobAutoScaler,
+    LocalResourceOptimizer,
+    OptimizeStage,
+)
+from dlrover_wuqiong_tpu.master.scaler import PodScaler, ScalePlan
+from dlrover_wuqiong_tpu.master.watcher import PodWatcher
+from dlrover_wuqiong_tpu.master.speed_monitor import SpeedMonitor
+from dlrover_wuqiong_tpu.scheduler import (
+    FakeSchedulerClient,
+    NodeSpec,
+    SubprocessSchedulerClient,
+    new_scheduler_client,
+)
+
+
+def _wait(cond, timeout=10.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TestSchedulerBackends:
+    def test_factory(self):
+        assert isinstance(new_scheduler_client("fake"), FakeSchedulerClient)
+        assert isinstance(new_scheduler_client("local"),
+                          SubprocessSchedulerClient)
+        with pytest.raises(ValueError):
+            new_scheduler_client("nope")
+
+    def test_fake_crud_and_watch(self):
+        c = FakeSchedulerClient()
+        assert c.create_node(NodeSpec(NodeType.WORKER, 0))
+        assert len(c.list_nodes()) == 1
+        events = list(c.watch(timeout=0.1))
+        assert len(events) == 1 and events[0].node.id == 0
+        assert c.delete_node(NodeType.WORKER, 0)
+        assert c.list_nodes() == []
+
+    def test_subprocess_lifecycle(self):
+        c = SubprocessSchedulerClient()
+        spec = NodeSpec(NodeType.WORKER, 0,
+                        command=[sys.executable, "-c",
+                                 "import time; time.sleep(30)"])
+        assert c.create_node(spec)
+        nodes = c.list_nodes()
+        assert nodes[0].status == NodeStatus.RUNNING
+        assert c.delete_node(NodeType.WORKER, 0)
+        assert c.list_nodes() == []
+
+    def test_subprocess_exit_events(self):
+        c = SubprocessSchedulerClient()
+        c.create_node(NodeSpec(NodeType.WORKER, 1,
+                               command=[sys.executable, "-c", "exit(3)"]))
+        events = []
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            events += list(c.watch(timeout=0.3))
+            if any(e.node.status == NodeStatus.FAILED for e in events):
+                break
+        failed = [e for e in events if e.node.status == NodeStatus.FAILED]
+        assert failed and "exit_code=3" in failed[0].node.exit_reason
+        c.close()
+
+
+class TestPodScaler:
+    def test_scale_plan(self):
+        c = FakeSchedulerClient()
+        s = PodScaler(c)
+        plan = ScalePlan(launch_nodes=[NodeSpec(NodeType.WORKER, i, i)
+                                       for i in range(3)])
+        s.scale(plan)
+        assert len(c.list_nodes()) == 3
+        node = Node(NodeType.WORKER, 1)
+        s.scale_down(node)
+        assert len(c.list_nodes()) == 2
+
+    def test_create_retry_on_platform_flake(self):
+        c = FakeSchedulerClient(fail_creates=2)
+        s = PodScaler(c, retry_interval=0.05)
+        s.scale_up(Node(NodeType.WORKER, 0))
+        assert _wait(lambda: len(c.list_nodes()) == 1, timeout=5)
+        assert len(c.create_calls) == 3  # 2 failures + 1 success
+        s.stop()
+
+
+class TestPodWatcher:
+    def test_events_reach_handler(self):
+        c = FakeSchedulerClient()
+        seen = []
+        w = PodWatcher(c, seen.append, poll_timeout=0.1)
+        w.start()
+        c.create_node(NodeSpec(NodeType.WORKER, 0))
+        c.set_node_status(NodeType.WORKER, 0, NodeStatus.RUNNING)
+        assert _wait(lambda: len(seen) >= 2)
+        w.stop()
+
+
+class TestDistJobManager:
+    def test_initial_scale_and_failure_relaunch(self):
+        c = FakeSchedulerClient()
+        jm = DistJobManager(c, num_workers=2)
+        jm.start()
+        assert _wait(lambda: len(c.list_nodes()) == 2)
+        # platform reports running, then one worker dies
+        c.set_node_status(NodeType.WORKER, 0, NodeStatus.RUNNING)
+        c.set_node_status(NodeType.WORKER, 1, NodeStatus.RUNNING)
+        c.set_node_status(NodeType.WORKER, 0, NodeStatus.FAILED,
+                          exit_reason=NodeExitReason.KILLED)
+        # relaunch drives a NEW create through the scaler
+        assert _wait(lambda: len(c.create_calls) >= 3)
+        jm.stop()
+
+    def test_fatal_error_not_relaunched(self):
+        c = FakeSchedulerClient()
+        jm = DistJobManager(c, num_workers=1)
+        jm.start()
+        assert _wait(lambda: len(c.list_nodes()) == 1)
+        c.set_node_status(NodeType.WORKER, 0, NodeStatus.RUNNING)
+        c.set_node_status(NodeType.WORKER, 0, NodeStatus.FAILED,
+                          exit_reason=NodeExitReason.FATAL_ERROR)
+        time.sleep(0.5)
+        assert len(c.create_calls) == 1  # no relaunch
+        jm.stop()
+
+
+class TestResourceOptimizer:
+    def test_phased_plans(self):
+        opt = LocalResourceOptimizer(
+            default_resource=NodeResource(cpu=2, memory_mb=1000),
+            sample_after=2, stable_after=4, headroom=2.0)
+        assert opt.stage() == OptimizeStage.INIT
+        assert opt.plan_node_resource().memory_mb == 1000
+        opt.report_usage(NodeType.WORKER, NodeResource(cpu=1, memory_mb=800))
+        opt.report_usage(NodeType.WORKER, NodeResource(cpu=1, memory_mb=900))
+        assert opt.stage() == OptimizeStage.SAMPLE
+        assert opt.plan_node_resource().memory_mb == 1800  # max * headroom
+        opt.report_usage(NodeType.WORKER, NodeResource(cpu=1, memory_mb=850))
+        opt.report_usage(NodeType.WORKER, NodeResource(cpu=1, memory_mb=820))
+        assert opt.stage() == OptimizeStage.STABLE
+        plan = opt.plan_node_resource()
+        assert 1600 <= plan.memory_mb <= 1800  # p95-ish * headroom
+
+    def test_oom_bump_capped(self):
+        opt = LocalResourceOptimizer(oom_factor=2.0, max_memory_mb=5000)
+        r = opt.bump_oom(NodeResource(cpu=1, memory_mb=2000))
+        assert r.memory_mb == 4000
+        r2 = opt.bump_oom(r)
+        assert r2.memory_mb == 5000  # capped
+
+
+class TestJobAutoScaler:
+    def _mk(self, target=3):
+        c = FakeSchedulerClient()
+        jm = DistJobManager(c, num_workers=target)
+        opt = LocalResourceOptimizer()
+        sm = SpeedMonitor()
+        scaler = PodScaler(c)
+        auto = JobAutoScaler(jm, sm, opt, scaler, target_workers=target,
+                             interval=3600)
+        return c, jm, auto
+
+    def test_reconcile_missing_workers(self):
+        c, jm, auto = self._mk(target=3)
+        # only 1 of 3 registered alive
+        n = jm.register_node(NodeType.WORKER, 0, rank_index=0)
+        n.update_status(NodeStatus.RUNNING)
+        plan = auto.decide()
+        assert len(plan.launch_nodes) == 2
+        ranks = sorted(s.rank_index for s in plan.launch_nodes)
+        assert ranks == [1, 2]  # fills the missing ranks
+        auto.execute(plan)
+        assert len(c.list_nodes()) == 2
+
+    def test_scale_down_removes_highest_ranks(self):
+        c, jm, auto = self._mk(target=2)
+        for i in range(4):
+            n = jm.register_node(NodeType.WORKER, i, rank_index=i)
+            n.update_status(NodeStatus.RUNNING)
+        plan = auto.decide()
+        assert {n.rank_index for n in plan.remove_nodes} == {2, 3}
+
+    def test_oom_event_bumps_resource(self):
+        _, jm, auto = self._mk()
+        node = jm.register_node(NodeType.WORKER, 0)
+        node.config_resource = NodeResource(cpu=1, memory_mb=1000)
+        auto.handle_oom(node)
+        assert node.config_resource.memory_mb > 1000
+
+
+class TestDistJobManagerSubprocess:
+    def test_requires_spec_factory(self):
+        with pytest.raises(ValueError, match="spec_factory"):
+            DistJobManager(SubprocessSchedulerClient(), num_workers=1)
+
+    def test_real_process_crash_relaunch_succeed(self, tmp_path):
+        """The same scaler/watcher path drives real processes: a worker
+        that fails twice then succeeds is relaunched until success."""
+        counter = tmp_path / "count"
+        script = (
+            "import os,sys;p=%r;n=int(open(p).read()) if os.path.exists(p)"
+            " else 0;open(p,'w').write(str(n+1));sys.exit(9 if n<2 else 0)"
+            % str(counter))
+
+        def spec_factory(node):
+            return NodeSpec(node.type, node.id,
+                            rank_index=node.rank_index or 0,
+                            command=[sys.executable, "-c", script],
+                            relaunch_count=node.relaunch_count)
+
+        client = SubprocessSchedulerClient()
+        jm = DistJobManager(client, num_workers=1,
+                            spec_factory=spec_factory)
+        jm.start()
+        assert _wait(jm.all_workers_succeeded, timeout=30)
+        assert any(n.relaunch_count > 0 for n in jm.all_nodes())
+        jm.stop()
+        client.close()

@@ -1,0 +1,94 @@
+"""Checkpoint replica manager tests.
+
+Mirrors reference `dlrover/trainer/tests/torch/checkpoint_backup_test.py`
+(backup/gather) — the kill-node test proves restore from a peer without a
+storage read.
+"""
+
+import numpy as np
+import pytest
+
+from dlrover_wuqiong_tpu.checkpoint.replica import (
+    CkptReplicaManager,
+    ReplicaServer,
+)
+from dlrover_wuqiong_tpu.checkpoint.shm_handler import SharedMemoryHandler
+
+
+@pytest.fixture()
+def two_nodes():
+    servers = [ReplicaServer(), ReplicaServer()]
+    for s in servers:
+        s.start()
+    peers = {r: f"127.0.0.1:{s.port}" for r, s in enumerate(servers)}
+    managers = [
+        CkptReplicaManager(rank=r, peers=peers, job_name=f"t-rep{r}",
+                           replica_count=1)
+        for r in range(2)
+    ]
+    yield servers, peers, managers
+    for m in managers:
+        m.close()
+    for r in range(2):
+        SharedMemoryHandler(0, f"t-rep{r}").unlink()
+    for s in servers:
+        s.stop()
+
+
+class TestReplica:
+    def test_ring_backup_and_peer_restore(self, two_nodes):
+        servers, peers, (m0, m1) = two_nodes
+        state = {"w": np.arange(64, dtype=np.float32).reshape(8, 8),
+                 "b": np.ones(8, np.float32)}
+        shm0 = SharedMemoryHandler(0, "t-rep0")
+        shm0.save_state_dict(state, step=7)
+        assert m0.backup() == 1  # shipped to rank 1's server
+
+        # node 0 dies: wipe its shm, a replacement manager restores from
+        # the peer WITHOUT any storage involved
+        shm0.unlink()
+        m0b = CkptReplicaManager(rank=0, peers=peers, job_name="t-rep0",
+                                 replica_count=1)
+        step = m0b.restore()
+        assert step == 7
+        restored = SharedMemoryHandler(0, "t-rep0").load_state_dict()
+        assert restored is not None
+        rstep, flat, _, _ = restored
+        assert rstep == 7
+        np.testing.assert_array_equal(flat["w"], state["w"])
+        np.testing.assert_array_equal(flat["b"], state["b"])
+        m0b.close()
+
+    def test_restore_without_backup_returns_none(self, two_nodes):
+        _, peers, (m0, _) = two_nodes
+        assert m0.restore() is None
+
+    def test_backup_skips_empty_shm(self, two_nodes):
+        _, _, (m0, _) = two_nodes
+        assert m0.backup() == 0
+
+    def test_ring_successors(self):
+        peers = {0: "a", 1: "b", 2: "c", 3: "d"}
+        m = CkptReplicaManager(rank=1, peers=peers, job_name="t-succ",
+                               replica_count=2)
+        assert m._successors() == [2, 3]
+        m2 = CkptReplicaManager(rank=3, peers=peers, job_name="t-succ2",
+                                replica_count=1)
+        assert m2._successors() == [0]
+        m.close()
+        m2.close()
+
+    def test_newer_backup_replaces_older(self, two_nodes):
+        _, peers, (m0, m1) = two_nodes
+        shm0 = SharedMemoryHandler(0, "t-rep0")
+        shm0.save_state_dict({"x": np.zeros(4, np.float32)}, step=1)
+        m0.backup()
+        shm0.save_state_dict({"x": np.full(4, 9.0, np.float32)}, step=2)
+        m0.backup()
+        shm0.unlink()
+        m0b = CkptReplicaManager(rank=0, peers=peers, job_name="t-rep0",
+                                 replica_count=1)
+        assert m0b.restore() == 2
+        _, flat, _, _ = SharedMemoryHandler(0, "t-rep0").load_state_dict()
+        np.testing.assert_array_equal(flat["x"], np.full(4, 9.0))
+        m0b.close()
