@@ -261,15 +261,40 @@ class CheckpointEngine:
         shm = self._shm_handler.load_state_dict()
         if shm is not None and (step is None or shm[0] == step):
             shm_step, flat, metas, extra = shm
+            entries = [dict(m.to_dict(), array=flat[m.name]) for m in metas]
             # no tag (legacy/foreign segment) must NOT pass the guard
             shm_dir = extra.get("_ckpt_dir")
             if shm_dir != (path or self.checkpoint_dir):
                 shm = None  # stale segment from a different job run
+            elif not self._full_coverage(entries):
+                # multi-process world: local shm holds only THIS process's
+                # shards — assembling would fill peer shards with garbage
+                # (and each process would restore different values).
+                # Storage has every rank's shards.
+                shm = None
             elif step is not None or shm_step >= read_last_step(
                     path or self.checkpoint_dir, self.storage):
-                return self._assemble(
-                    [dict(m.to_dict(), array=flat[m.name]) for m in metas])
+                return self._assemble(entries)
         return self.load_from_storage(path, step)
+
+    @staticmethod
+    def _full_coverage(entries) -> bool:
+        """True iff every sharded tensor's shards tile its global shape."""
+        import math
+
+        vol: Dict[str, int] = {}
+        glob: Dict[str, tuple] = {}
+        for e in entries:
+            name = e["name"]
+            base = name.split("#shard")[0]
+            if "#shard" not in name:
+                continue  # whole tensor present
+            glob[base] = tuple(e["global_shape"])
+            v = 1
+            for s, t in e["index"]:
+                v *= max(0, t - s)
+            vol[base] = vol.get(base, 0) + v
+        return all(vol.get(b, 0) >= math.prod(gs) for b, gs in glob.items())
 
     def load_from_storage(self, path: Optional[str] = None,
                           step: Optional[int] = None

@@ -134,15 +134,20 @@ class CheckpointConstant:
 
 
 class JobConstant:
+    import os as _os
+
     RDZV_JOIN_TIMEOUT_DEFAULT = 600
-    HEARTBEAT_INTERVAL_SECS = 15
+    HEARTBEAT_INTERVAL_SECS = float(
+        _os.getenv("DWT_HEARTBEAT_INTERVAL_SECS", "15"))
     HEARTBEAT_TIMEOUT_SECS = 300
     MASTER_SERVICE_DEFAULT_PORT = 0  # 0 → pick a free port
     TRAINING_AGENT_LOOP_INTERVAL = 1
     NODE_CHECK_TIMEOUT_SECS = 300
     PENDING_NODE_TIMEOUT_SECS = 900
-    # Min interval between two membership-driven restarts
-    RESTART_DEBOUNCE_SECS = 30
+    # Min interval between two membership-driven restarts (env-overridable:
+    # elasticity e2e tests need tighter loops than production)
+    RESTART_DEBOUNCE_SECS = float(
+        _os.getenv("DWT_RESTART_DEBOUNCE_SECS", "30"))
 
 
 class ConfigPath:

@@ -203,6 +203,10 @@ class JobManager:
             old_node.is_released = True
         logger.info("relaunching %s as node %s (attempt %d)", old_node,
                     new_id, new_node.relaunch_count)
+        # a hung node (heartbeat timeout) is still RUNNING on the platform —
+        # tear it down before its replacement, or both consume resources
+        # (delete of an already-dead pod/process is an idempotent no-op)
+        self._scaler.scale_down(old_node)
         self._scaler.scale_up(new_node)
         for listener in self._relaunch_listeners:
             listener(old_node, new_node)

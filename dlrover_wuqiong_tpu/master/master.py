@@ -17,7 +17,12 @@ from ..common.constants import JobExitReason, RendezvousName
 from ..common.global_context import get_context
 from ..common.log import get_logger
 from ..diagnosis.manager import DiagnosisManager
-from .job_manager import JobManager, LocalJobManager, Scaler
+from .job_manager import (
+    JobManager,
+    LocalJobManager,
+    NodeEventCallback,
+    Scaler,
+)
 from .kv_store import KVStoreService
 from .rendezvous import (
     ElasticTrainingRendezvousManager,
@@ -54,6 +59,22 @@ class JobMaster:
                 join_timeout=ctx.rdzv_join_timeout,
                 node_unit=node_unit)
         self.kv_store = KVStoreService()
+        # uniform failure cleanup regardless of which monitor detected it
+        # (watcher event, heartbeat sweep, or explicit failure report) —
+        # parity: reference event_callback.py wiring at dist_master.py:195
+        master = self
+
+        class _CleanupCallback(NodeEventCallback):
+            def on_node_failed(self, node):
+                master.task_manager.recover_tasks(node.id)
+                for rdzv in master.rdzv_managers.values():
+                    rdzv.remove_alive_node(node.id)
+                master.speed_monitor.remove_running_worker(node.id)
+
+            def on_node_deleted(self, node):
+                self.on_node_failed(node)
+
+        self.job_manager.add_node_event_callback(_CleanupCallback())
         self.diagnosis_manager = DiagnosisManager(ctx.hang_detection_seconds)
         self._custom_metrics: Dict = {}
         self._node_events: list = []

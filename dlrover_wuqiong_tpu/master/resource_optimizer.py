@@ -185,8 +185,15 @@ class JobAutoScaler:
             next_id = max(used) + 1 if used else 0
             ranks = {n.rank_index for n in alive}
             free_ranks = [r for r in range(want) if r not in ranks]
+            # beyond the free slots, continue with fresh sequential ranks
+            # (duplicate rank hints would collide at rendezvous)
+            next_rank = max(ranks | set(free_ranks), default=-1) + 1
             for i in range(missing):
-                rank = free_ranks[i] if i < len(free_ranks) else next_id
+                if i < len(free_ranks):
+                    rank = free_ranks[i]
+                else:
+                    rank = next_rank
+                    next_rank += 1
                 plan.launch_nodes.append(NodeSpec(
                     node_type=NodeType.WORKER, node_id=next_id + i,
                     rank_index=rank, resource=resource))

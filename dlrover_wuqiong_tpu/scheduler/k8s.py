@@ -121,11 +121,13 @@ class K8sSchedulerClient(SchedulerClient):
         node.status = _POD_PHASE_TO_STATUS.get(
             getattr(pod.status, "phase", "Unknown"), NodeStatus.BREAKDOWN)
         statuses = getattr(pod.status, "container_statuses", None) or []
+        from ..common.constants import NodeExitReason
+
         for cs in statuses:
             term = getattr(cs.state, "terminated", None)
             if term is not None and term.exit_code not in (0, None):
                 node.exit_reason = (
-                    "oom" if term.reason == "OOMKilled"
+                    NodeExitReason.OOM if term.reason == "OOMKilled"
                     else f"exit_code={term.exit_code}")
         return node
 

@@ -51,7 +51,14 @@ class SubprocessSchedulerClient(SchedulerClient):
                                     start_new_session=True)
         except OSError as e:
             logger.error("failed to launch %s: %s", spec.command, e)
+            if stdout is not None:
+                stdout.close()
             return False
+        if stdout is not None:
+            # the child inherited its own descriptor at fork — close the
+            # parent's copy now (leaking one per relaunch would exhaust the
+            # master's fd limit over a long crash-looping job)
+            stdout.close()
         node = Node(spec.node_type, spec.node_id,
                     rank_index=spec.rank_index,
                     config_resource=spec.resource)
