@@ -97,12 +97,13 @@ class ElasticAgent:
         self._last_restart_ts = 0.0
         self._replica_server = None
         self._replica_manager = None
-        # last rendezvous round this agent's worker ran in: a re-join after
-        # failure must wait for a NEWER round — accepting the stale
-        # completed world hands out a dead coordinator and the restarted
-        # workers split across two worlds (deadlock until the jax
+        # last rendezvous round this agent ran in, PER rendezvous name
+        # (network-check and elastic-training managers count independently):
+        # a re-join after failure must wait for a NEWER round — accepting
+        # the stale completed world hands out a dead coordinator and the
+        # restarted workers split across two worlds (deadlock until the jax
         # distributed init timeout)
-        self._last_rdzv_round = -1
+        self._last_rdzv_round: Dict[str, int] = {}
 
     # ------------------------------------------------------------- rendezvous
 
@@ -121,7 +122,8 @@ class ElasticAgent:
         deadline = time.time() + self.config.rdzv_timeout
         while time.time() < deadline:
             state = self.mc.get_comm_world(rdzv_name=name)
-            if state.complete and state.rdzv_round <= self._last_rdzv_round:
+            if state.complete and state.rdzv_round <= \
+                    self._last_rdzv_round.get(name, -1):
                 # stale world from before our re-join — wait for the next
                 time.sleep(0.5)
                 continue
@@ -143,7 +145,7 @@ class ElasticAgent:
                         node_ip=os.getenv("DWT_NODE_IP", "127.0.0.1"),
                         free_port=free_port)
                     continue
-                self._last_rdzv_round = state.rdzv_round
+                self._last_rdzv_round[name] = state.rdzv_round
                 return RendezvousOutcome(
                     state.rdzv_round, my_rank, total_procs,
                     state.coordinator_addr, self.config.nproc_per_node)

@@ -115,6 +115,14 @@ def _s_pp(ctx: StrategyContext, cfg: Dict, num_devices: int):
     ctx.extra["pp_microbatches"] = cfg.get("microbatches")
 
 
+@register_strategy("local_sgd")
+@register_strategy("hsdp")
+def _s_local_sgd(ctx: StrategyContext, cfg: Dict, num_devices: int):
+    """DiLoCo two-level training over the dp axis (parallel/local_sgd.py).
+    cfg: sync_every/outer_lr/outer_momentum/nesterov/reduce."""
+    ctx.extra["local_sgd"] = dict(cfg)
+
+
 @register_strategy("amp_native")
 @register_strategy("half")
 def _s_amp(ctx: StrategyContext, cfg: Dict, num_devices: int):
@@ -293,12 +301,37 @@ def auto_accelerate(
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     params = model.init_params(rng)
     optimizer = optimizer or optax.adamw(3e-4)
-    state = TrainState.create(params, optimizer)
-    state, state_sh = shard_train_state(state, planner)
-
     loss = loss_fn or make_lm_loss(model.apply)
-    step = make_train_step(loss, optimizer, mesh, planner,
-                           accum_steps=ctx.accum_steps)
+
+    if ctx.extra.get("local_sgd") is not None:
+        # DiLoCo two-level training (parallel/local_sgd.py): the dp axis
+        # becomes the replica-group axis that only syncs every H steps
+        from ..parallel.local_sgd import (
+            LocalSGDConfig,
+            init_diloco_state,
+            make_diloco_train_step,
+        )
+
+        ls_cfg = LocalSGDConfig(**ctx.extra["local_sgd"])
+        if ctx.plan.dp < 2:
+            raise ValueError(
+                "local_sgd needs ('data_parallel', {'size': R>=2}) — the "
+                "dp axis carries the locally-training replica groups")
+        if ctx.accum_steps > 1:
+            raise ValueError("local_sgd does not compose with grad_accum "
+                             "yet")
+        state = init_diloco_state(params, optimizer, mesh, planner, ls_cfg)
+        step = make_diloco_train_step(loss, optimizer, mesh, planner,
+                                      ls_cfg)
+        state_sh = jax.tree.map(lambda x: x.sharding, state)
+        logger.info("local_sgd (DiLoCo): dp=%d groups, sync every %d steps,"
+                    " reduce=%s", ctx.plan.dp, ls_cfg.sync_every,
+                    ls_cfg.reduce)
+    else:
+        state = TrainState.create(params, optimizer)
+        state, state_sh = shard_train_state(state, planner)
+        step = make_train_step(loss, optimizer, mesh, planner,
+                               accum_steps=ctx.accum_steps)
     logger.info("auto_accelerate: mesh=%s params=%s accum=%d",
                 ctx.plan.describe(),
                 f"{num_params:,}" if num_params else "?", ctx.accum_steps)

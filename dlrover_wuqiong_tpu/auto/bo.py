@@ -1,0 +1,145 @@
+"""Bayesian optimization for continuous hyperparameters.
+
+Parity: reference `dlrover/python/brain/hpsearch/bo.py:30`
+(`BayesianOptimizer`) and `hpsearch/base.py:28` (`OptimizerBase`) — the
+offline search used for tunables the discrete strategy engine doesn't
+cover (learning rates, microbatch counts, checkpoint intervals).
+
+Self-contained numpy implementation: Gaussian-process surrogate (RBF
+kernel, jittered Cholesky) + expected-improvement acquisition maximized
+over random restarts.  No sklearn dependency.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Param:
+    name: str
+    low: float
+    high: float
+    log_scale: bool = False
+
+    def to_unit(self, v: float) -> float:
+        if self.log_scale:
+            return ((math.log(v) - math.log(self.low))
+                    / (math.log(self.high) - math.log(self.low)))
+        return (v - self.low) / (self.high - self.low)
+
+    def from_unit(self, u: float) -> float:
+        u = min(1.0, max(0.0, u))
+        if self.log_scale:
+            return math.exp(math.log(self.low)
+                            + u * (math.log(self.high)
+                                   - math.log(self.low)))
+        return self.low + u * (self.high - self.low)
+
+
+def _rbf(a: np.ndarray, b: np.ndarray, ls: float) -> np.ndarray:
+    d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+    return np.exp(-0.5 * d2 / (ls * ls))
+
+
+class GaussianProcess:
+    def __init__(self, length_scale: float = 0.2, noise: float = 1e-6):
+        self.ls = length_scale
+        self.noise = noise
+        self._x: Optional[np.ndarray] = None
+        self._y_mean = 0.0
+        self._y_std = 1.0
+        self._alpha: Optional[np.ndarray] = None
+        self._chol: Optional[np.ndarray] = None
+
+    def fit(self, x: np.ndarray, y: np.ndarray):
+        self._x = np.asarray(x, float)
+        y = np.asarray(y, float)
+        self._y_mean = float(y.mean())
+        self._y_std = float(y.std()) or 1.0
+        yn = (y - self._y_mean) / self._y_std
+        k = _rbf(self._x, self._x, self.ls)
+        k[np.diag_indices_from(k)] += self.noise
+        # jittered cholesky: bump the diagonal until PD
+        jitter = 0.0
+        for _ in range(8):
+            try:
+                self._chol = np.linalg.cholesky(
+                    k + jitter * np.eye(len(k)))
+                break
+            except np.linalg.LinAlgError:
+                jitter = max(1e-10, jitter * 10 or 1e-10)
+        self._alpha = np.linalg.solve(
+            self._chol.T, np.linalg.solve(self._chol, yn))
+
+    def predict(self, x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        kx = _rbf(np.asarray(x, float), self._x, self.ls)
+        mu = kx @ self._alpha
+        v = np.linalg.solve(self._chol, kx.T)
+        var = np.clip(1.0 - (v * v).sum(0), 1e-12, None)
+        return (mu * self._y_std + self._y_mean,
+                np.sqrt(var) * self._y_std)
+
+
+def _norm_cdf(z):
+    return 0.5 * (1.0 + np.vectorize(math.erf)(z / math.sqrt(2.0)))
+
+
+def _norm_pdf(z):
+    return np.exp(-0.5 * z * z) / math.sqrt(2.0 * math.pi)
+
+
+class BayesianOptimizer:
+    """Minimize a black-box objective over a box of Params.
+
+    Usage (ask/tell, mirroring the reference's generator interface):
+        bo = BayesianOptimizer([Param("lr", 1e-5, 1e-2, log_scale=True)])
+        for _ in range(20):
+            cfg = bo.ask()
+            bo.tell(cfg, objective(cfg))
+        best_cfg, best_y = bo.best()
+    """
+
+    def __init__(self, params: Sequence[Param], seed: int = 0,
+                 n_init: int = 5, xi: float = 0.01):
+        self.params = list(params)
+        self._rng = np.random.default_rng(seed)
+        self._n_init = n_init
+        self._xi = xi
+        self._xs: List[np.ndarray] = []   # unit cube
+        self._ys: List[float] = []
+        self._gp = GaussianProcess()
+
+    def _to_cfg(self, u: np.ndarray) -> Dict[str, float]:
+        return {p.name: p.from_unit(float(u[i]))
+                for i, p in enumerate(self.params)}
+
+    def ask(self) -> Dict[str, float]:
+        d = len(self.params)
+        if len(self._xs) < self._n_init:
+            u = self._rng.random(d)
+            self._pending = u
+            return self._to_cfg(u)
+        self._gp.fit(np.stack(self._xs), np.array(self._ys))
+        best = min(self._ys)
+        cand = self._rng.random((256, d))
+        mu, sigma = self._gp.predict(cand)
+        imp = best - mu - self._xi
+        z = imp / sigma
+        ei = imp * _norm_cdf(z) + sigma * _norm_pdf(z)
+        u = cand[int(np.argmax(ei))]
+        self._pending = u
+        return self._to_cfg(u)
+
+    def tell(self, cfg: Dict[str, float], y: float):
+        u = np.array([p.to_unit(cfg[p.name]) for p in self.params])
+        self._xs.append(u)
+        self._ys.append(float(y))
+
+    def best(self) -> Tuple[Dict[str, float], float]:
+        i = int(np.argmin(self._ys))
+        return self._to_cfg(self._xs[i]), self._ys[i]

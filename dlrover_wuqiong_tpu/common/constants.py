@@ -8,6 +8,8 @@ exposes coordinator address + process ids rather than MASTER_ADDR/RANK.
 
 from __future__ import annotations
 
+import os
+
 
 class NodeEnv:
     """Environment variables that wire agents/workers to the master."""
@@ -134,11 +136,9 @@ class CheckpointConstant:
 
 
 class JobConstant:
-    import os as _os
-
     RDZV_JOIN_TIMEOUT_DEFAULT = 600
     HEARTBEAT_INTERVAL_SECS = float(
-        _os.getenv("DWT_HEARTBEAT_INTERVAL_SECS", "15"))
+        os.getenv("DWT_HEARTBEAT_INTERVAL_SECS", "15"))
     HEARTBEAT_TIMEOUT_SECS = 300
     MASTER_SERVICE_DEFAULT_PORT = 0  # 0 → pick a free port
     TRAINING_AGENT_LOOP_INTERVAL = 1
@@ -147,7 +147,7 @@ class JobConstant:
     # Min interval between two membership-driven restarts (env-overridable:
     # elasticity e2e tests need tighter loops than production)
     RESTART_DEBOUNCE_SECS = float(
-        _os.getenv("DWT_RESTART_DEBOUNCE_SECS", "30"))
+        os.getenv("DWT_RESTART_DEBOUNCE_SECS", "30"))
 
 
 class ConfigPath:

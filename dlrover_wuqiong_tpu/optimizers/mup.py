@@ -1,0 +1,151 @@
+"""muP — maximal-update parametrization for width-transferable HPs.
+
+Parity: reference `atorch/atorch/mup/` (module.py MupModule, optim.py
+MuAdam/MuSGD, shape.py base-shape inference, init.py scaled initializers).
+
+Optax-idiom redesign: no module wrappers.  Base shapes come from a small
+"base" model's param tree; each target param gets a width multiplier and a
+role (input / hidden / output / finite), and
+  - `mup_init` rescales initial hidden/output weights by 1/sqrt(mult)
+    (variance ∝ 1/fan_in as fan_in grows),
+  - `mup_adam`/`mup_sgd` wrap optax with per-param lr scaling following
+    the μP table (Adam: hidden & output lr ∝ 1/mult; SGD: hidden lr ∝
+    const, output ∝ 1/mult, input ∝ mult),
+  - attention uses 1/d scores instead of 1/sqrt(d) (pass
+    `sm_scale=1/head_dim` to the attention op).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..common.log import get_logger
+
+logger = get_logger("mup")
+
+_INPUT_RE = re.compile(
+    r".*(wte|wpe|embed|embedding|input_proj)", re.IGNORECASE)
+_OUTPUT_RE = re.compile(r".*(lm_head|output|head)/", re.IGNORECASE)
+
+
+def _path_of(key_path) -> str:
+    parts = []
+    for p in key_path:
+        parts.append(str(getattr(p, "key", getattr(p, "idx",
+                                                   getattr(p, "name", p)))))
+    return "/".join(parts)
+
+
+def classify_param(path: str, base_shape: Tuple[int, ...],
+                   shape: Tuple[int, ...]) -> str:
+    """'input' | 'hidden' | 'output' | 'finite' (μP Table 8 roles)."""
+    grown = [i for i, (b, s) in enumerate(zip(base_shape, shape)) if b != s]
+    if not grown or len(shape) < 2:
+        return "finite"  # biases, norms, scalars — width-independent
+    if _INPUT_RE.match(path):
+        return "input"
+    if _OUTPUT_RE.match(path):
+        return "output"
+    return "hidden"
+
+
+def width_mults(base_params: Any, params: Any) -> Any:
+    """Per-leaf {mult, role}: mult = fan_in growth factor vs the base model.
+
+    Parity: shape.py base-shape comparison — the "infinite" dims are the
+    ones that differ between base and target.
+    """
+    flat_b = jax.tree_util.tree_flatten_with_path(base_params)[0]
+    flat_t = jax.tree_util.tree_flatten_with_path(params)[0]
+    if len(flat_b) != len(flat_t):
+        raise ValueError("base and target models differ in structure")
+    info = {}
+    for (pb, lb), (pt, lt) in zip(flat_b, flat_t):
+        path = _path_of(pt)
+        bs, ts = tuple(lb.shape), tuple(lt.shape)
+        role = classify_param(path, bs, ts)
+        if len(ts) >= 2 and role != "finite":
+            # fan_in is the second-to-last dim for kernels (in, out);
+            # embeddings (vocab, emb) treat the feature dim as the width
+            fan_idx = len(ts) - 2 if role != "input" else len(ts) - 1
+            mult = ts[fan_idx] / max(1, bs[fan_idx])
+        else:
+            mult = 1.0
+        info[path] = {"mult": float(mult), "role": role}
+    treedef = jax.tree_util.tree_structure(params)
+    leaves = [info[_path_of(p)] for p, _ in flat_t]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def mup_init(params: Any, mults: Any) -> Any:
+    """Rescale initial weights per μP: hidden/output std ∝ 1/sqrt(mult).
+
+    Parity: init.py scaled initializers — applied post-init so any flax
+    initializer composes.
+    """
+    def _scale(x, m):
+        if m["role"] in ("hidden", "output") and m["mult"] != 1.0:
+            return x / jnp.sqrt(m["mult"]).astype(x.dtype)
+        return x
+
+    return jax.tree.map(_scale, params, mults,
+                        is_leaf=lambda x: isinstance(x, dict)
+                        and "mult" in x)
+
+
+def _lr_factor(role: str, mult: float, adam: bool) -> float:
+    if mult == 1.0 or role == "finite":
+        return 1.0
+    if adam:
+        # μP Table 8 (Adam): hidden & output lr ∝ 1/mult; input const
+        return 1.0 / mult if role in ("hidden", "output") else 1.0
+    # SGD: input ∝ mult, hidden const, output ∝ 1/mult
+    if role == "input":
+        return mult
+    if role == "output":
+        return 1.0 / mult
+    return 1.0
+
+
+def scale_by_mup(mults: Any, adam: bool = True
+                 ) -> optax.GradientTransformation:
+    """Per-param update scaling implementing the μP lr table."""
+
+    factors = jax.tree.map(
+        lambda m: _lr_factor(m["role"], m["mult"], adam), mults,
+        is_leaf=lambda x: isinstance(x, dict) and "mult" in x)
+
+    def init_fn(params):
+        return optax.EmptyState()
+
+    def update_fn(updates, state, params=None):
+        return jax.tree.map(lambda u, f: u * f, updates, factors), state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def mup_adam(learning_rate, mults: Any, b1: float = 0.9, b2: float = 0.999,
+             eps: float = 1e-8, weight_decay: float = 0.0
+             ) -> optax.GradientTransformation:
+    """MuAdam (parity optim.py MuAdam): adam then μP per-param lr scale."""
+    base = optax.adamw(learning_rate, b1=b1, b2=b2, eps=eps,
+                       weight_decay=weight_decay) if weight_decay \
+        else optax.adam(learning_rate, b1=b1, b2=b2, eps=eps)
+    return optax.chain(base, scale_by_mup(mults, adam=True))
+
+
+def mup_sgd(learning_rate, mults: Any, momentum: Optional[float] = None
+            ) -> optax.GradientTransformation:
+    """MuSGD (parity optim.py MuSGD)."""
+    return optax.chain(optax.sgd(learning_rate, momentum=momentum),
+                       scale_by_mup(mults, adam=False))
+
+
+def mup_attn_scale(head_dim: int) -> float:
+    """μP attention: 1/d scores instead of 1/sqrt(d)."""
+    return 1.0 / head_dim

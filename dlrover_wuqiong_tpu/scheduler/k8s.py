@@ -14,7 +14,11 @@ from __future__ import annotations
 import threading
 from typing import Iterator, List, Optional
 
-from ..common.constants import NodeEventType, NodeStatus
+from ..common.constants import (
+    NodeEventType,
+    NodeExitReason,
+    NodeStatus,
+)
 from ..common.log import get_logger
 from ..common.node import Node, NodeEvent, NodeResource
 from .base import NodeSpec, SchedulerClient
@@ -121,8 +125,6 @@ class K8sSchedulerClient(SchedulerClient):
         node.status = _POD_PHASE_TO_STATUS.get(
             getattr(pod.status, "phase", "Unknown"), NodeStatus.BREAKDOWN)
         statuses = getattr(pod.status, "container_statuses", None) or []
-        from ..common.constants import NodeExitReason
-
         for cs in statuses:
             term = getattr(cs.state, "terminated", None)
             if term is not None and term.exit_code not in (0, None):

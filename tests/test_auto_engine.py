@@ -1,0 +1,116 @@
+"""Strategy search engine + Bayesian optimization tests.
+
+Mirrors reference `atorch/tests/common_tests` engine/strategy tests and
+`dlrover/python/tests/test_hpsearch_bo.py`.
+"""
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dlrover_wuqiong_tpu.auto.bo import BayesianOptimizer, Param
+from dlrover_wuqiong_tpu.auto.engine import (
+    Candidate,
+    generate_candidates,
+    score_candidate,
+    search_strategy,
+)
+from dlrover_wuqiong_tpu.models.gpt import GPT, GPTConfig
+from dlrover_wuqiong_tpu.parallel.mesh import MeshPlan
+
+
+class TestCandidateGeneration:
+    def test_divisibility_constraints(self):
+        cands = generate_candidates(8, n_head=4, n_layer=2,
+                                    with_remat=False)
+        for c in cands:
+            assert 4 % c.plan.tp == 0
+            assert 2 % c.plan.pp == 0
+            assert c.plan.num_devices == 8
+        # tp can't exceed head count divisors
+        assert all(c.plan.tp in (1, 2, 4) for c in cands)
+        assert any(c.plan.pp == 2 for c in cands)
+
+    def test_remat_doubles_space(self):
+        a = generate_candidates(4, with_remat=False)
+        b = generate_candidates(4, with_remat=True)
+        assert len(b) == 2 * len(a)
+
+    def test_strategy_roundtrip(self):
+        c = Candidate(plan=MeshPlan(tp=2, fsdp=4), remat=True)
+        strat = dict(c.strategy())
+        assert strat["tensor_parallel"] == {"size": 2}
+        assert strat["fsdp"] == {"size": 4}
+        assert strat["checkpoint"] == {"enabled": True}
+
+
+class TestScoring:
+    def _model_batch(self):
+        cfg = dataclasses.replace(GPTConfig.nano(), dtype=jnp.float32,
+                                  use_flash_attention=False, remat=False)
+        data = np.random.default_rng(0).integers(0, cfg.vocab_size, (8, 33))
+        batch = {"input_ids": jnp.asarray(data[:, :-1]),
+                 "labels": jnp.asarray(data[:, 1:])}
+        return GPT(cfg), batch, cfg
+
+    def test_score_feasible_candidate(self):
+        model, batch, cfg = self._model_batch()
+        c = Candidate(plan=MeshPlan(fsdp=8))
+        score_candidate(c, model, optax.adam(1e-2), batch,
+                        jax.devices())
+        assert c.feasible
+        assert 0 < c.score < math.inf
+
+    def test_infeasible_marked_not_raised(self):
+        model, batch, cfg = self._model_batch()
+        # tp=8 > n_head=2 → ulysses/TP head divisibility fails inside
+        c = Candidate(plan=MeshPlan(tp=8, fsdp=1))
+        score_candidate(c, model, optax.adam(1e-2), batch, jax.devices())
+        # nano has 2 heads; tp=8 model may still build (GSPMD pads) — the
+        # point is: no exception escapes, feasibility is recorded
+        assert isinstance(c.feasible, bool)
+
+    def test_search_returns_ranked(self):
+        model, batch, cfg = self._model_batch()
+        top = search_strategy(model, optax.adam(1e-2), batch,
+                              jax.devices(), n_head=cfg.n_head,
+                              n_layer=cfg.n_layer, top_k=3)
+        assert top
+        scores = [c.score for c in top]
+        assert scores == sorted(scores)
+        assert all(c.feasible for c in top)
+
+
+class TestBayesianOptimizer:
+    def test_finds_quadratic_minimum(self):
+        bo = BayesianOptimizer([Param("x", -2.0, 2.0)], seed=1, n_init=4)
+        for _ in range(25):
+            cfg = bo.ask()
+            bo.tell(cfg, (cfg["x"] - 0.7) ** 2)
+        best_cfg, best_y = bo.best()
+        assert abs(best_cfg["x"] - 0.7) < 0.25
+        assert best_y < 0.08
+
+    def test_log_scale_param(self):
+        p = Param("lr", 1e-5, 1e-1, log_scale=True)
+        assert abs(p.from_unit(p.to_unit(1e-3)) - 1e-3) < 1e-9
+        bo = BayesianOptimizer([p], seed=0, n_init=3)
+        # minimum at lr=1e-3 on a log parabola
+        for _ in range(20):
+            cfg = bo.ask()
+            bo.tell(cfg, (math.log10(cfg["lr"]) + 3.0) ** 2)
+        best_cfg, _ = bo.best()
+        assert 1e-4 < best_cfg["lr"] < 1e-2
+
+    def test_multidim(self):
+        bo = BayesianOptimizer([Param("a", 0, 1), Param("b", 0, 1)],
+                               seed=2, n_init=5)
+        for _ in range(30):
+            cfg = bo.ask()
+            bo.tell(cfg, (cfg["a"] - 0.3) ** 2 + (cfg["b"] - 0.6) ** 2)
+        best_cfg, best_y = bo.best()
+        assert best_y < 0.1

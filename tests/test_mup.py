@@ -1,0 +1,145 @@
+"""muP tests: classification, lr table, and the coordinate check —
+hidden-activation scale must stay ~width-independent under μP while
+drifting with width under standard parametrization.
+
+Mirrors reference atorch/mup tests in spirit.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dlrover_wuqiong_tpu.optimizers.mup import (
+    classify_param,
+    mup_adam,
+    mup_attn_scale,
+    mup_init,
+    width_mults,
+)
+
+
+class MLP(nn.Module):
+    width: int
+    vocab: int = 32
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Embed(self.vocab, self.width, name="embed")(x)
+        h = nn.relu(nn.Dense(self.width, name="hidden1")(h))
+        h = nn.relu(nn.Dense(self.width, name="hidden2")(h))
+        return nn.Dense(self.vocab, name="lm_head")(h)
+
+
+def _init(width, seed=0):
+    m = MLP(width)
+    p = m.init(jax.random.PRNGKey(seed), jnp.zeros((2, 4), jnp.int32))
+    return m, p["params"]
+
+
+class TestClassification:
+    def test_roles(self):
+        _, base = _init(8)
+        _, big = _init(32)
+        mults = width_mults(base, big)
+        assert mults["embed"]["embedding"]["role"] == "input"
+        assert mults["hidden1"]["kernel"]["role"] == "hidden"
+        assert mults["hidden1"]["kernel"]["mult"] == 4.0
+        assert mults["lm_head"]["kernel"]["role"] == "output"
+        assert mults["hidden1"]["bias"]["role"] == "finite"
+
+    def test_finite_when_same_width(self):
+        _, a = _init(8)
+        _, b = _init(8, seed=1)
+        mults = width_mults(a, b)
+        for leaf in jax.tree.leaves(
+                mults, is_leaf=lambda x: isinstance(x, dict)
+                and "mult" in x):
+            assert leaf["role"] == "finite" or leaf["mult"] == 1.0
+
+    def test_classify_param_direct(self):
+        assert classify_param("h/ln/scale", (8,), (32,)) == "finite"
+        assert classify_param("wte/embedding", (32, 8), (32, 32)) == "input"
+        assert classify_param("lm_head/kernel", (8, 32),
+                              (32, 32)) == "output"
+        assert classify_param("mlp/kernel", (8, 8), (32, 32)) == "hidden"
+
+
+class TestInitAndLr:
+    def test_init_rescale(self):
+        _, base = _init(8)
+        _, big = _init(32)
+        mults = width_mults(base, big)
+        scaled = mup_init(big, mults)
+        # hidden kernel shrunk by sqrt(4)=2; embedding untouched
+        np.testing.assert_allclose(
+            np.asarray(scaled["hidden1"]["kernel"]),
+            np.asarray(big["hidden1"]["kernel"]) / 2.0)
+        np.testing.assert_array_equal(
+            np.asarray(scaled["embed"]["embedding"]),
+            np.asarray(big["embed"]["embedding"]))
+
+    def test_adam_lr_table(self):
+        _, base = _init(8)
+        _, big = _init(32)
+        mults = width_mults(base, big)
+        opt = mup_adam(1.0, mults)
+        state = opt.init(big)
+        grads = jax.tree.map(jnp.ones_like, big)
+        updates, _ = opt.update(grads, state, big)
+        # adam normalizes to ~1; μP divides hidden/output by mult=4
+        hid = float(jnp.abs(updates["hidden1"]["kernel"]).mean())
+        emb = float(jnp.abs(updates["embed"]["embedding"]).mean())
+        assert abs(emb / hid - 4.0) < 0.2
+
+    def test_attn_scale(self):
+        assert mup_attn_scale(64) == 1.0 / 64
+
+
+class TestCoordinateCheck:
+    """The μP acceptance test: after a few training steps, hidden
+    pre-activation magnitudes stay O(1) across widths under μP, while SP
+    (standard Adam) grows them with width."""
+
+    def _run(self, width, use_mup, steps=5, lr=1e-2):
+        model, params = _init(width)
+        _, base = _init(8)
+        if use_mup:
+            mults = width_mults(base, params)
+            params = mup_init(params, mults)
+            opt = mup_adam(lr, mults)
+        else:
+            opt = optax.adam(lr)
+        state = opt.init(params)
+        x = jax.random.randint(jax.random.PRNGKey(1), (16, 4), 0, 32)
+        y = jax.random.randint(jax.random.PRNGKey(2), (16, 4), 0, 32)
+
+        @jax.jit
+        def step(params, state):
+            def loss_fn(p):
+                logits = model.apply({"params": p}, x)
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits, y).mean()
+            g = jax.grad(loss_fn)(params)
+            updates, state = opt.update(g, state, params)
+            return optax.apply_updates(params, updates), state
+
+        for _ in range(steps):
+            params, state = step(params, state)
+        # magnitude of the 2nd hidden pre-activation
+        h = nn.Embed(32, width).apply(
+            {"params": params["embed"]}, x)
+        h = nn.relu(nn.Dense(width).apply({"params": params["hidden1"]}, h))
+        pre = nn.Dense(width).apply({"params": params["hidden2"]}, h)
+        return float(jnp.abs(pre).mean())
+
+    def test_mup_width_stability(self):
+        mags_mup = [self._run(w, use_mup=True) for w in (32, 128, 512)]
+        mags_sp = [self._run(w, use_mup=False) for w in (32, 128, 512)]
+        ratio_mup = mags_mup[-1] / mags_mup[0]
+        ratio_sp = mags_sp[-1] / mags_sp[0]
+        # μP: roughly flat across 16x width; SP: grows markedly faster
+        assert ratio_mup < 2.0, (mags_mup, mags_sp)
+        assert ratio_sp > ratio_mup * 1.5, (mags_mup, mags_sp)
