@@ -121,9 +121,7 @@ class BayesianOptimizer:
     def ask(self) -> Dict[str, float]:
         d = len(self.params)
         if len(self._xs) < self._n_init:
-            u = self._rng.random(d)
-            self._pending = u
-            return self._to_cfg(u)
+            return self._to_cfg(self._rng.random(d))
         self._gp.fit(np.stack(self._xs), np.array(self._ys))
         best = min(self._ys)
         cand = self._rng.random((256, d))
@@ -131,9 +129,7 @@ class BayesianOptimizer:
         imp = best - mu - self._xi
         z = imp / sigma
         ei = imp * _norm_cdf(z) + sigma * _norm_pdf(z)
-        u = cand[int(np.argmax(ei))]
-        self._pending = u
-        return self._to_cfg(u)
+        return self._to_cfg(cand[int(np.argmax(ei))])
 
     def tell(self, cfg: Dict[str, float], y: float):
         u = np.array([p.to_unit(cfg[p.name]) for p in self.params])

@@ -77,6 +77,8 @@ def generate_candidates(num_devices: int, n_head: int = 0,
                 continue
             for ep in _divisors_pow2(num_devices // (tp * pp),
                                      num_experts or 1):
+                if num_experts and num_experts % ep:
+                    continue
                 remaining = num_devices // (tp * pp * ep)
                 plan = MeshPlan(tp=tp, pp=pp, ep=ep, fsdp=remaining)
                 remats = (False, True) if with_remat else (False,)

@@ -51,6 +51,29 @@ def step_dir(path: str, step: int) -> str:
     return os.path.join(path, f"{CheckpointConstant.CKPT_NAME_PREFIX}{step}")
 
 
+class _ViewsReader:
+    """Read-only file object over a list of shm memoryviews (zero-copy
+    until the storage backend's own chunking)."""
+
+    def __init__(self, views):
+        self._views = views
+        self._i = 0
+        self._off = 0
+
+    def read(self, n: int = -1) -> bytes:
+        if self._i >= len(self._views):
+            return b""
+        view = self._views[self._i]
+        if n is None or n < 0:
+            n = len(view) - self._off
+        chunk = bytes(view[self._off:self._off + n])
+        self._off += len(chunk)
+        if self._off >= len(view):
+            self._i += 1
+            self._off = 0
+        return chunk
+
+
 class CheckpointEvent:
     @staticmethod
     def save(step: int, path: str) -> Dict:
@@ -322,21 +345,39 @@ class AsyncCheckpointSaver:
         global_rank = self._global_rank(local_rank)
         meta_path = os.path.join(sdir, f"meta_rank{global_rank}.json")
         bin_path = os.path.join(sdir, f"shards_rank{global_rank}.bin")
-        # stream raw shard bytes; record each tensor's offset in the bin file
         metas_out: List[Dict] = []
-        tmp = f"{bin_path}.tmp.{os.getpid()}"
-        os.makedirs(os.path.dirname(bin_path), exist_ok=True)
-        offset = 0
-        with open(tmp, "wb") as f:
+        from ..common.storage import PosixDiskStorage
+
+        if isinstance(self.storage, PosixDiskStorage):
+            # fast path: stream shm → file with an atomic rename commit
+            tmp = f"{bin_path}.tmp.{os.getpid()}"
+            os.makedirs(os.path.dirname(bin_path), exist_ok=True)
+            offset = 0
+            with open(tmp, "wb") as f:
+                for meta, view in handler.iter_shards():
+                    f.write(view)
+                    d = meta.to_dict()
+                    d["file_offset"] = offset
+                    offset += meta.nbytes
+                    metas_out.append(d)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, bin_path)
+        else:
+            # object store (gs://...): stream shm views straight into the
+            # object writer — no host-RAM copy of the (possibly tens-of-GB)
+            # shard set; commit-by-done-file keeps atomicity (object writes
+            # are already atomic)
+            views = []
+            offset = 0
             for meta, view in handler.iter_shards():
-                f.write(view)
+                views.append(view)
                 d = meta.to_dict()
                 d["file_offset"] = offset
                 offset += meta.nbytes
                 metas_out.append(d)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, bin_path)
+            self.storage.write_fileobj(_ViewsReader(views), bin_path,
+                                       offset)
         self.storage.write(json.dumps({
             "step": step,
             "extra": header.get("extra", {}),

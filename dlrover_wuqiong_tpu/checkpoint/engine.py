@@ -54,7 +54,9 @@ class CheckpointEngine:
         self.checkpoint_dir = checkpoint_dir
         self.local_rank = local_rank
         self.job_name = job_name
-        self.storage = storage or get_checkpoint_storage()
+        # gs://... checkpoint dirs resolve to the object-store backend
+        self.storage = storage or get_checkpoint_storage(
+            path_hint=checkpoint_dir)
         self._shm_handler = SharedMemoryHandler(local_rank, job_name)
         self._saver: Optional[AsyncCheckpointSaver] = None
         self._event_queue: Optional[SharedQueue] = None

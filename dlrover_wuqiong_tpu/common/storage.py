@@ -105,9 +105,89 @@ class PosixDiskStorage(CheckpointStorage):
         return sorted(os.listdir(path))
 
 
+class ObjectStoreStorage(CheckpointStorage):
+    """Cloud object-store backend (gs:// / s3://) via `etils.epath`.
+
+    A multi-host TPU job's checkpoints live in GCS, not on local disk —
+    this backend gives the flash-ckpt saver the same interface there.
+    epath routes to the appropriate filesystem implementation; hosts
+    without the cloud filesystem deps fail at use-time with the
+    underlying error (the posix paths keep working through epath too).
+    """
+
+    def __init__(self, **kwargs):
+        from etils import epath  # lazy: orbax dependency, always present
+
+        self._epath = epath
+
+    def _p(self, path: str):
+        return self._epath.Path(path)
+
+    def write(self, content, path: str):
+        p = self._p(path)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        if isinstance(content, str):
+            p.write_text(content)
+        else:
+            p.write_bytes(bytes(content))
+
+    def write_fileobj(self, fileobj, path: str, length: int):
+        p = self._p(path)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        with p.open("wb") as f:
+            remaining = length
+            while remaining > 0:
+                chunk = fileobj.read(min(1 << 20, remaining))
+                if not chunk:
+                    break
+                f.write(chunk)
+                remaining -= len(chunk)
+
+    def read(self, path: str, mode: str = "rb"):
+        p = self._p(path)
+        try:
+            return p.read_text() if "b" not in mode else p.read_bytes()
+        except (FileNotFoundError, OSError):
+            return None
+
+    def safe_makedirs(self, path: str):
+        self._p(path).mkdir(parents=True, exist_ok=True)
+
+    def safe_remove(self, path: str):
+        p = self._p(path)
+        try:
+            if p.is_dir():
+                p.rmtree()
+            elif p.exists():
+                p.unlink()
+        except OSError:
+            pass
+
+    def exists(self, path: str) -> bool:
+        return self._p(path).exists()
+
+    def listdir(self, path: str):
+        p = self._p(path)
+        if not p.exists():
+            return []
+        try:
+            return sorted(c.name for c in p.iterdir())
+        except (NotADirectoryError, OSError):
+            return []
+
+    def commit(self, step: int, success: bool):
+        pass
+
+    def get_class_meta(self) -> Dict:
+        return {"class_name": type(self).__name__, "kwargs": {}}
+
+
 _STORAGE_REGISTRY: Dict[str, Type[CheckpointStorage]] = {
     "PosixDiskStorage": PosixDiskStorage,
+    "ObjectStoreStorage": ObjectStoreStorage,
 }
+
+_OBJECT_SCHEMES = ("gs://", "s3://", "az://")
 
 
 def register_storage(cls: Type[CheckpointStorage]):
@@ -115,8 +195,13 @@ def register_storage(cls: Type[CheckpointStorage]):
     return cls
 
 
-def get_checkpoint_storage(meta: Optional[Dict] = None) -> CheckpointStorage:
+def get_checkpoint_storage(meta: Optional[Dict] = None,
+                           path_hint: str = "") -> CheckpointStorage:
+    """Resolve a backend — by explicit meta, or by the target path's scheme
+    (gs://... → object store)."""
     if not meta:
+        if path_hint.startswith(_OBJECT_SCHEMES):
+            return ObjectStoreStorage()
         return PosixDiskStorage()
     cls = _STORAGE_REGISTRY.get(meta.get("class_name", "PosixDiskStorage"),
                                 PosixDiskStorage)

@@ -1,16 +1,31 @@
-"""Diagnosis subsystem: collect runtime reports, infer failures (hang, slow).
+"""Diagnosis subsystem: observe → infer → act.
 
-Parity: reference `dlrover/python/master/diagnosis/` (`DiagnosisManager` :31,
-`_diagnose_failures` :67, `InferenceChain`, `CheckTrainingHangOperator`) and
-data model `common/diagnosis.py`.  TPU adaptation: reports carry step progress,
-host resource stats, and (later) libtpu chip metrics instead of CudaLog.
+Parity: reference `dlrover/python/master/diagnosis/` — `DiagnosisManager`
+(diagnosis.py:31, `_diagnose_failures` :67), `InferenceChain`
+(inferencechain/inference_chain.py), `CheckTrainingHangOperator`
+(operator/check_training_hang_operator.py), data model
+`common/diagnosis.py`, and the restart-decision coupling back into the job
+manager.
+
+TPU adaptation: reports carry step progress, host resource stats and worker
+stacks instead of CudaLog; the "chip" signal is step cadence (an ICI/HBM
+fault shows up as a straggling or stalled step long before anything else).
+
+Structure: symptom operators raise `Inference` problems; cause operators
+refine compatible problems into root-cause conclusions; the manager turns
+conclusions into `DiagnosisAction`s and (when wired with a job manager)
+executes them — restart_worker sets the restart flag delivered via
+heartbeat, relaunch_node pushes a FAILED event through the relaunch
+decision table.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import json
 import threading
 import time
-from collections import defaultdict, deque
+from collections import deque
 from typing import Deque, Dict, List, Optional
 
 from ..common import messages as msg
@@ -19,55 +34,20 @@ from ..common.log import get_logger
 logger = get_logger("diagnosis")
 
 
-class InferenceOperator:
-    """One rule in the inference chain: observations -> conclusions."""
-
-    name = "base"
-
-    def infer(self, data: "DiagnosisDataManager") -> List[msg.DiagnosisAction]:
-        return []
+# -------------------------------------------------------------- data model
 
 
-class CheckTrainingHangOperator(InferenceOperator):
-    """Training is hanged if no node reported step progress for `timeout` s.
+@dataclasses.dataclass
+class Inference:
+    """A problem or conclusion flowing through the chain.
 
-    Parity: reference diagnosis/operator/check_training_hang_operator.py.
+    Parity: reference common/inference.py (name/attribution/description).
     """
 
-    name = "check_training_hang"
-
-    def __init__(self, timeout: float = 1800.0):
-        self.timeout = timeout
-
-    def infer(self, data: "DiagnosisDataManager") -> List[msg.DiagnosisAction]:
-        latest = data.latest_step_time()
-        if latest is None:
-            return []
-        if time.time() - latest > self.timeout:
-            return [msg.DiagnosisAction(
-                action="restart_worker",
-                reason=f"no step progress for >{self.timeout}s")]
-        return []
-
-
-class CheckResourceAnomalyOperator(InferenceOperator):
-    """Flag nodes with pathological host-memory growth (OOM precursor)."""
-
-    name = "check_resource_anomaly"
-
-    def __init__(self, memory_limit_mb: float = 0.0):
-        self.memory_limit_mb = memory_limit_mb
-
-    def infer(self, data: "DiagnosisDataManager") -> List[msg.DiagnosisAction]:
-        if self.memory_limit_mb <= 0:
-            return []
-        actions = []
-        for node_id, stats in data.latest_resource_stats().items():
-            if stats.get("memory_mb", 0.0) > self.memory_limit_mb:
-                actions.append(msg.DiagnosisAction(
-                    action="relaunch_node", node_id=node_id,
-                    reason="host memory over limit"))
-        return actions
+    name: str                      # e.g. "training_hang", "straggler"
+    node_id: int = -1
+    detail: str = ""
+    is_conclusion: bool = False
 
 
 class DiagnosisDataManager:
@@ -76,8 +56,17 @@ class DiagnosisDataManager:
     def __init__(self, window: int = 600):
         self._lock = threading.Lock()
         self._step_reports: Deque = deque(maxlen=window)
-        self._resource: Dict[int, Dict[str, float]] = {}
+        self._node_steps: Dict[int, Deque] = {}
+        self._resource: Dict[int, Deque] = {}
         self._stacks: Dict[int, str] = {}
+
+    def forget_node(self, node_id: int):
+        """Drop a departed node's series — stale timestamps otherwise keep
+        getting blamed as hang culprits / OOM candidates forever."""
+        with self._lock:
+            self._node_steps.pop(node_id, None)
+            self._resource.pop(node_id, None)
+            self._stacks.pop(node_id, None)
 
     def store_report(self, report: msg.DiagnosisReport):
         with self._lock:
@@ -85,11 +74,14 @@ class DiagnosisDataManager:
             if report.payload_type == "step":
                 self._step_reports.append((ts, report.node_id,
                                            report.content))
+                self._node_steps.setdefault(
+                    report.node_id, deque(maxlen=64)).append(ts)
             elif report.payload_type == "resource":
                 try:
-                    import json
-                    self._resource[report.node_id] = json.loads(
-                        report.content)
+                    stats = json.loads(report.content)
+                    self._resource.setdefault(
+                        report.node_id, deque(maxlen=64)).append(
+                        (ts, stats))
                 except ValueError:
                     pass
             elif report.payload_type == "stack":
@@ -101,30 +93,248 @@ class DiagnosisDataManager:
                 return None
             return self._step_reports[-1][0]
 
+    def node_step_times(self) -> Dict[int, List[float]]:
+        with self._lock:
+            return {n: list(d) for n, d in self._node_steps.items()}
+
     def latest_resource_stats(self) -> Dict[int, Dict[str, float]]:
         with self._lock:
-            return dict(self._resource)
+            return {n: d[-1][1] for n, d in self._resource.items() if d}
+
+    def resource_series(self, node_id: int) -> List:
+        with self._lock:
+            return list(self._resource.get(node_id, ()))
 
     def node_stack(self, node_id: int) -> str:
         with self._lock:
             return self._stacks.get(node_id, "")
 
 
-class DiagnosisManager:
-    """Periodic inference over collected metrics (parity diagnosis.py:31)."""
+# --------------------------------------------------------------- operators
 
-    def __init__(self, hang_timeout: float = 1800.0):
+
+class InferenceOperator:
+    """One rule in the chain. Symptom ops take no input problems; cause ops
+    declare which problem names they refine."""
+
+    name = "base"
+    refines: tuple = ()  # problem names this operator can resolve
+
+    def infer(self, data: DiagnosisDataManager,
+              problems: List[Inference]) -> List[Inference]:
+        return []
+
+
+class CheckTrainingHangOperator(InferenceOperator):
+    """Symptom: no step progress anywhere for `timeout` seconds.
+
+    Parity: reference operator/check_training_hang_operator.py.
+    """
+
+    name = "check_training_hang"
+
+    def __init__(self, timeout: float = 1800.0):
+        self.timeout = timeout
+
+    def infer(self, data, problems):
+        latest = data.latest_step_time()
+        if latest is None:
+            return []
+        if time.time() - latest > self.timeout:
+            return [Inference("training_hang",
+                              detail=f"no step progress for "
+                                     f">{self.timeout:.0f}s")]
+        return []
+
+
+class ResolveHangCauseOperator(InferenceOperator):
+    """Cause: which node stopped first / looks stuck (stack available)."""
+
+    name = "resolve_hang_cause"
+    refines = ("training_hang",)
+
+    def infer(self, data, problems):
+        out = []
+        for p in problems:
+            if p.name not in self.refines:
+                continue
+            node_steps = data.node_step_times()
+            if node_steps:
+                # the node whose last report is OLDEST stalled first
+                culprit, ts = min(
+                    ((n, times[-1]) for n, times in node_steps.items()
+                     if times), key=lambda kv: kv[1])
+                stack = data.node_stack(culprit)
+                out.append(Inference(
+                    "hang_culprit", node_id=culprit, is_conclusion=True,
+                    detail=(p.detail + f"; node {culprit} stalled first"
+                            + ("; stack available" if stack else ""))))
+            else:
+                out.append(Inference("training_hang", is_conclusion=True,
+                                     detail=p.detail))
+        return out
+
+
+class CheckStragglerOperator(InferenceOperator):
+    """Symptom+conclusion: a node stepping far slower than its peers.
+
+    Parity: the straggler half of the network-check subsystem
+    (rdzv_manager.py:532 get_straggler) driven from runtime cadence.
+    """
+
+    name = "check_straggler"
+
+    def __init__(self, ratio: float = 3.0, min_reports: int = 6):
+        self.ratio = ratio
+        self.min_reports = min_reports
+
+    @staticmethod
+    def _median(xs: List[float]) -> float:
+        s = sorted(xs)
+        return s[len(s) // 2]
+
+    def infer(self, data, problems):
+        cadence = {}
+        for node, times in data.node_step_times().items():
+            if len(times) >= self.min_reports:
+                deltas = [b - a for a, b in zip(times, times[1:])]
+                cadence[node] = self._median(deltas)
+        if len(cadence) < 2:
+            return []
+        med = self._median(list(cadence.values()))
+        if med <= 0:
+            return []
+        out = []
+        for node, c in cadence.items():
+            if c > self.ratio * med:
+                out.append(Inference(
+                    "straggler", node_id=node, is_conclusion=True,
+                    detail=f"step cadence {c:.2f}s vs peer median "
+                           f"{med:.2f}s"))
+        return out
+
+
+class CheckMemoryTrendOperator(InferenceOperator):
+    """Conclusion: host memory trending toward the limit (OOM precursor)."""
+
+    name = "check_memory_trend"
+
+    def __init__(self, memory_limit_mb: float = 0.0,
+                 horizon_s: float = 600.0, min_points: int = 4):
+        self.memory_limit_mb = memory_limit_mb
+        self.horizon_s = horizon_s
+        self.min_points = min_points
+
+    def infer(self, data, problems):
+        if self.memory_limit_mb <= 0:
+            return []
+        out = []
+        now = time.time()
+        for node_id in list(data.latest_resource_stats()):
+            series = [(ts, s.get("memory_mb", 0.0))
+                      for ts, s in data.resource_series(node_id)]
+            if not series:
+                continue
+            mem_now = series[-1][1]
+            if mem_now > self.memory_limit_mb:
+                out.append(Inference(
+                    "memory_over_limit", node_id=node_id,
+                    is_conclusion=True,
+                    detail=f"{mem_now:.0f}MB > {self.memory_limit_mb:.0f}"
+                           f"MB"))
+                continue
+            if len(series) < self.min_points:
+                continue
+            (t0, m0), (t1, m1) = series[0], series[-1]
+            if t1 <= t0 or m1 <= m0:
+                continue
+            slope = (m1 - m0) / (t1 - t0)  # MB/s
+            eta = (self.memory_limit_mb - m1) / slope
+            if eta < self.horizon_s:
+                out.append(Inference(
+                    "memory_trend", node_id=node_id, is_conclusion=True,
+                    detail=f"{m1:.0f}MB growing {slope * 60:.1f}MB/min — "
+                           f"limit in ~{eta:.0f}s"))
+        return out
+
+
+class InferenceChain:
+    """Run symptom operators, then refine until conclusions stabilize.
+
+    Parity: reference inferencechain/inference_chain.py.
+    """
+
+    def __init__(self, operators: List[InferenceOperator]):
+        self.operators = operators
+
+    def run(self, data: DiagnosisDataManager) -> List[Inference]:
+        problems: List[Inference] = []
+        for op in self.operators:
+            if op.refines:
+                continue
+            try:
+                problems.extend(op.infer(data, []))
+            except Exception:  # noqa: BLE001
+                logger.exception("diagnosis operator %s failed", op.name)
+        open_problems = [p for p in problems if not p.is_conclusion]
+        conclusions = [p for p in problems if p.is_conclusion]
+        for op in self.operators:
+            if not op.refines or not open_problems:
+                continue
+            try:
+                refined = op.infer(data, open_problems)
+            except Exception:  # noqa: BLE001
+                logger.exception("diagnosis operator %s failed", op.name)
+                continue
+            resolved_names = {p.name for p in open_problems
+                              if p.name in op.refines}
+            open_problems = [p for p in open_problems
+                             if p.name not in resolved_names]
+            conclusions.extend(r for r in refined if r.is_conclusion)
+            open_problems.extend(r for r in refined if not r.is_conclusion)
+        # unrefined problems surface as conclusions of their own
+        conclusions.extend(open_problems)
+        return conclusions
+
+
+_ACTION_FOR = {
+    "training_hang": "restart_worker",
+    "hang_culprit": "restart_worker",
+    "straggler": "report",           # surfaced; operator policy decides
+    "memory_over_limit": "relaunch_node",
+    "memory_trend": "report",
+}
+
+
+class DiagnosisManager:
+    """Periodic inference + action execution (parity diagnosis.py:31)."""
+
+    def __init__(self, hang_timeout: float = 1800.0,
+                 memory_limit_mb: float = 0.0, job_manager=None,
+                 action_cooldown: float = 0.0):
         self.data = DiagnosisDataManager()
-        self._operators: List[InferenceOperator] = [
+        self.chain = InferenceChain([
             CheckTrainingHangOperator(hang_timeout),
-            CheckResourceAnomalyOperator(),
-        ]
-        self._pending_actions: Deque[msg.DiagnosisAction] = deque()
+            CheckStragglerOperator(),
+            CheckMemoryTrendOperator(memory_limit_mb),
+            ResolveHangCauseOperator(),
+        ])
+        self.job_manager = job_manager
+        # min seconds between re-firing the same (action, node) — a hang
+        # that takes minutes to recover must not be re-killed every tick
+        # while the restarted worker is still compiling.  Default: half
+        # the hang timeout.
+        self.action_cooldown = action_cooldown or max(hang_timeout / 2,
+                                                      120.0)
+        self._last_fired: Dict[tuple, float] = {}
+        self._pending_actions: Deque[msg.DiagnosisAction] = deque(
+            maxlen=100)
         self._lock = threading.Lock()
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
-    def collect_report(self, report: msg.DiagnosisReport) -> msg.DiagnosisAction:
+    def collect_report(self, report: msg.DiagnosisReport
+                       ) -> msg.DiagnosisAction:
         self.data.store_report(report)
         with self._lock:
             if self._pending_actions:
@@ -132,15 +342,61 @@ class DiagnosisManager:
         return msg.DiagnosisAction()
 
     def diagnose_once(self) -> List[msg.DiagnosisAction]:
-        actions: List[msg.DiagnosisAction] = []
-        for op in self._operators:
-            try:
-                actions.extend(op.infer(self.data))
-            except Exception:  # noqa: BLE001
-                logger.exception("diagnosis operator %s failed", op.name)
+        conclusions = self.chain.run(self.data)
+        now = time.time()
+        actions = []
+        for c in conclusions:
+            action = _ACTION_FOR.get(c.name, "report")
+            key = (action, c.node_id)
+            if action != "report":
+                last = self._last_fired.get(key, 0.0)
+                if now - last < self.action_cooldown:
+                    continue  # still recovering from the previous action
+                self._last_fired[key] = now
+            actions.append(msg.DiagnosisAction(
+                action=action, node_id=c.node_id,
+                reason=f"{c.name}: {c.detail}"))
+        for a in actions:
+            self._execute(a)
         with self._lock:
-            self._pending_actions.extend(actions)
+            self._pending_actions.extend(
+                a for a in actions if a.action != "report")
         return actions
+
+    def _execute(self, action: msg.DiagnosisAction):
+        """Couple conclusions back into the job manager's decision table.
+
+        Parity: the reference master acts on diagnosis through the same
+        relaunch machinery as platform events.
+        """
+        if self.job_manager is None or action.action == "report":
+            return
+        try:
+            if action.action == "restart_worker":
+                nodes = ([self.job_manager.get_node(action.node_id)]
+                         if action.node_id >= 0
+                         else self.job_manager.running_nodes())
+                for node in nodes:
+                    if node is not None:
+                        node.restart_training = True
+            elif action.action == "relaunch_node":
+                from ..common.constants import (
+                    NodeEventType,
+                    NodeExitReason,
+                    NodeStatus,
+                )
+                from ..common.node import Node, NodeEvent
+
+                target = self.job_manager.get_node(action.node_id)
+                if target is not None:
+                    ev = Node(target.type, target.id,
+                              rank_index=target.rank_index)
+                    ev.status = NodeStatus.FAILED
+                    ev.exit_reason = NodeExitReason.OOM
+                    self.job_manager.process_event(
+                        NodeEvent(NodeEventType.MODIFIED, ev))
+        except Exception:  # noqa: BLE001
+            logger.exception("diagnosis action %s failed", action.action)
 
     def start(self, interval: float = 60.0):
         def _loop():

@@ -70,12 +70,14 @@ class JobMaster:
                 for rdzv in master.rdzv_managers.values():
                     rdzv.remove_alive_node(node.id)
                 master.speed_monitor.remove_running_worker(node.id)
+                master.diagnosis_manager.data.forget_node(node.id)
 
             def on_node_deleted(self, node):
                 self.on_node_failed(node)
 
         self.job_manager.add_node_event_callback(_CleanupCallback())
-        self.diagnosis_manager = DiagnosisManager(ctx.hang_detection_seconds)
+        self.diagnosis_manager = DiagnosisManager(
+            ctx.hang_detection_seconds, job_manager=self.job_manager)
         self._custom_metrics: Dict = {}
         self._node_events: list = []
         self._paral_config = msg.ParallelConfig()

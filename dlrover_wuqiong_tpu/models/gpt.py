@@ -142,7 +142,8 @@ class GPT(nn.Module):
     config: GPTConfig
 
     @nn.compact
-    def __call__(self, idx, deterministic: bool = True):
+    def __call__(self, idx, deterministic: bool = True,
+                 return_hidden: bool = False):
         cfg = self.config
         B, T = idx.shape
         tok = nn.Embed(cfg.vocab_size, cfg.n_embd,
@@ -159,6 +160,8 @@ class GPT(nn.Module):
         # weight-tied lm head (einsum against wte)
         wte = self.variables["params"]["wte"]["embedding"]
         logits = jnp.einsum("bte,ve->btv", x, wte.astype(cfg.dtype))
+        if return_hidden:  # e.g. a value head on the trunk (rl/ppo.py)
+            return logits, x
         return logits
 
     def init_params(self, rng, batch: int = 1, seq: int = 8):

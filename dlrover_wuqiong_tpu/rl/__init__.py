@@ -1,0 +1,22 @@
+"""RLHF engine: KV-cache generation + PPO (reference atorch/rl parity)."""
+
+from .generation import SampleConfig, generate
+from .ppo import (
+    ActorCritic,
+    PPOConfig,
+    PPOTrainer,
+    ReplayBuffer,
+    gae_advantages,
+    ppo_loss,
+)
+
+__all__ = [
+    "SampleConfig",
+    "generate",
+    "ActorCritic",
+    "PPOConfig",
+    "PPOTrainer",
+    "ReplayBuffer",
+    "gae_advantages",
+    "ppo_loss",
+]

@@ -1,0 +1,145 @@
+"""Autoregressive generation with a KV cache for the GPT family.
+
+Parity: the reference RLHF engine's generation backend
+(`atorch/atorch/rl/model_engine/model_engine.py:35` routes generation to a
+vLLM backend; the capability is "sample responses from the actor policy").
+
+TPU redesign: decode is a `lax.scan` over positions with static shapes —
+(k, v) cache buffers of length `max_len` updated via dynamic_update_slice,
+one fused step program for the whole sampling loop (no per-token dispatch).
+The cached forward reuses the SAME parameter tree as `models/gpt.GPT`
+(paths h_<i>/attn/..., wte, wpe, ln_f), so a policy trained with the
+standard model generates without conversion.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..models.gpt import GPTConfig
+
+
+def _ln(p, x, dtype):
+    return nn.LayerNorm(dtype=dtype).apply({"params": p}, x)
+
+
+def _dense(p, x, dtype):
+    return (x @ p["kernel"].astype(dtype)) + p["bias"].astype(dtype)
+
+
+def _cached_block(cfg: GPTConfig, p: Dict, x, cache_k, cache_v, pos):
+    """One decoder block for ONE new token position with a KV cache.
+
+    x: (B, 1, C); cache_k/v: (B, max_len, H, D); pos: scalar index.
+    Returns (y, new_k, new_v).
+    """
+    B = x.shape[0]
+    H, D = cfg.n_head, cfg.head_dim
+    dtype = cfg.dtype
+    h = _ln(p["ln_1"], x, dtype)
+    qkv = _dense(p["attn"]["c_attn"], h, dtype)       # (B, 1, 3C)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, 1, H, D)
+    k = k.reshape(B, 1, H, D)
+    v = v.reshape(B, 1, H, D)
+    cache_k = jax.lax.dynamic_update_slice(cache_k, k, (0, pos, 0, 0))
+    cache_v = jax.lax.dynamic_update_slice(cache_v, v, (0, pos, 0, 0))
+    # attend over positions <= pos
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, cache_k) / jnp.sqrt(
+        jnp.float32(D)).astype(dtype)
+    mask = (jnp.arange(cache_k.shape[1]) <= pos)[None, None, None, :]
+    scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+    att = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(dtype)
+    y = jnp.einsum("bhqk,bkhd->bqhd", att, cache_v).reshape(B, 1, H * D)
+    y = _dense(p["attn"]["c_proj"], y, dtype)
+    x = x + y
+    h = _ln(p["ln_2"], x, dtype)
+    h = _dense(p["mlp"]["c_fc"], h, dtype)
+    h = jax.nn.gelu(h)
+    h = _dense(p["mlp"]["c_proj"], h, dtype)
+    return x + h, cache_k, cache_v
+
+
+def _forward_one(cfg: GPTConfig, params: Dict, token, caches, pos):
+    """token (B, 1) int → logits (B, vocab); updates all layer caches."""
+    dtype = cfg.dtype
+    tok = params["wte"]["embedding"][token].astype(dtype)    # (B, 1, C)
+    pe = params["wpe"]["embedding"][pos][None, None].astype(dtype)
+    x = tok + pe
+    new_caches = []
+    for i in range(cfg.n_layer):
+        ck, cv = caches[i]
+        x, ck, cv = _cached_block(cfg, params[f"h_{i}"], x, ck, cv, pos)
+        new_caches.append((ck, cv))
+    x = _ln(params["ln_f"], x, dtype)
+    logits = jnp.einsum(
+        "bte,ve->btv", x, params["wte"]["embedding"].astype(dtype))
+    return logits[:, 0], new_caches
+
+
+def _init_caches(cfg: GPTConfig, batch: int, max_len: int):
+    return [(jnp.zeros((batch, max_len, cfg.n_head, cfg.head_dim),
+                       cfg.dtype),
+             jnp.zeros((batch, max_len, cfg.n_head, cfg.head_dim),
+                       cfg.dtype)) for _ in range(cfg.n_layer)]
+
+
+@dataclasses.dataclass(frozen=True)
+class SampleConfig:
+    max_new_tokens: int = 32
+    temperature: float = 1.0
+    top_k: int = 0           # 0 = full softmax
+    eos_token: int = -1      # -1 = never stop early (static shapes)
+
+
+def generate(cfg: GPTConfig, params: Dict, prompt: jax.Array,
+             rng: jax.Array, sample: SampleConfig = SampleConfig()
+             ) -> Tuple[jax.Array, jax.Array]:
+    """Sample continuations. prompt (B, P) int32 → (tokens (B, P+N),
+    logprobs (B, N)) — logprobs are the policy's per-sampled-token log
+    probabilities (what PPO needs).
+    """
+    B, P = prompt.shape
+    N = sample.max_new_tokens
+    total = P + N
+    if total > cfg.block_size:
+        raise ValueError(f"prompt+new ({total}) exceeds block size "
+                         f"{cfg.block_size}")
+    caches = _init_caches(cfg, B, total)
+
+    def prefill(carry, i):
+        caches, _ = carry
+        logits, caches = _forward_one(cfg, params, prompt[:, i][:, None],
+                                      caches, i)
+        return (caches, logits), None
+
+    (caches, logits), _ = jax.lax.scan(
+        prefill, (caches, jnp.zeros((B, cfg.vocab_size), jnp.float32)),
+        jnp.arange(P))
+
+    def _sample_token(logits, key):
+        logits = logits.astype(jnp.float32) / max(sample.temperature, 1e-6)
+        if sample.top_k > 0:
+            kth = jax.lax.top_k(logits, sample.top_k)[0][:, -1:]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        tok = jax.random.categorical(key, logits)
+        logp = jax.nn.log_softmax(logits, -1)
+        return tok, jnp.take_along_axis(logp, tok[:, None], 1)[:, 0]
+
+    def decode(carry, i):
+        caches, logits, key = carry
+        key, sub = jax.random.split(key)
+        tok, logp = _sample_token(logits, sub)
+        next_logits, caches = _forward_one(cfg, params, tok[:, None],
+                                           caches, P + i)
+        return (caches, next_logits, key), (tok, logp)
+
+    (_, _, _), (toks, logps) = jax.lax.scan(
+        decode, (caches, logits, rng), jnp.arange(N))
+    tokens = jnp.concatenate([prompt, toks.T.astype(prompt.dtype)], axis=1)
+    return tokens, logps.T

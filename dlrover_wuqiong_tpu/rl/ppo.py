@@ -1,0 +1,244 @@
+"""PPO for language models — the RLHF training engine.
+
+Parity: reference `atorch/atorch/rl/` — `ModelEngine`
+(model_engine/model_engine.py:35: actor/critic/ref/reward roles),
+`PPOTrainer` (trainer/ppo_trainer.py), PPO math (`ppo_utils/ppo_util.py`:
+GAE, ratio clipping, value clipping, KL penalty vs the frozen reference
+policy), and the replay buffer.
+
+TPU redesign: one jitted update step over the mesh (GSPMD shards the
+models exactly like pretraining); rollouts run through the KV-cache
+`generate` scan.  The four model roles collapse to two parameter trees —
+actor+critic share the transformer trunk with a value head (the standard
+PPO-LM economy), and the frozen reference policy is a second tree.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..common.log import get_logger
+from ..models.gpt import GPT, GPTConfig
+from .generation import SampleConfig, generate
+
+logger = get_logger("ppo")
+
+
+class ActorCritic(nn.Module):
+    """GPT trunk + scalar value head (parity: critic sharing the actor
+    trunk, rl/model_utils model wrapping)."""
+
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, idx):
+        cfg = self.config
+        logits, hidden = GPT(cfg, name="gpt")(idx, return_hidden=True)
+        values = nn.Dense(1, dtype=jnp.float32, name="value_head")(
+            hidden.astype(jnp.float32))
+        return logits, values[..., 0]
+
+    def init_params(self, rng, batch: int = 1, seq: int = 8):
+        idx = jnp.zeros((batch, seq), jnp.int32)
+        return self.init(rng, idx)["params"]
+
+
+@dataclasses.dataclass(frozen=True)
+class PPOConfig:
+    clip_ratio: float = 0.2
+    value_clip: float = 0.2
+    gamma: float = 1.0
+    lam: float = 0.95
+    kl_coef: float = 0.05           # penalty vs the reference policy
+    vf_coef: float = 0.5
+    entropy_coef: float = 0.0
+    ppo_epochs: int = 2
+    lr: float = 1e-5
+    max_new_tokens: int = 16
+    temperature: float = 1.0
+
+
+def gae_advantages(rewards, values, gamma: float, lam: float):
+    """Generalized advantage estimation over the response segment.
+
+    rewards/values: (B, N) per response token (terminal bootstrap 0).
+    Parity: ppo_util.py GAE.
+    """
+    def step(carry, xs):
+        r, v, v_next = xs
+        delta = r + gamma * v_next - v
+        adv = delta + gamma * lam * carry
+        return adv, adv
+
+    v_next = jnp.concatenate([values[:, 1:],
+                              jnp.zeros_like(values[:, :1])], axis=1)
+    _, advs = jax.lax.scan(
+        step, jnp.zeros(rewards.shape[0]),
+        (rewards.T, values.T, v_next.T), reverse=True)
+    advs = advs.T
+    returns = advs + values
+    return advs, returns
+
+
+class Rollout(NamedTuple):
+    tokens: jax.Array       # (B, P+N)
+    logprobs: jax.Array     # (B, N) behavior-policy logprobs
+    ref_logprobs: jax.Array  # (B, N)
+    values: jax.Array       # (B, N)
+    rewards: jax.Array      # (B, N) env reward + KL penalty folded in
+    advantages: jax.Array   # (B, N)
+    returns: jax.Array      # (B, N)
+    prompt_len: int
+
+
+def _response_logprobs_values(model: ActorCritic, params, tokens,
+                              prompt_len: int):
+    """Teacher-forced per-token logprobs/values for the response part."""
+    logits, values = model.apply({"params": params}, tokens[:, :-1])
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+    targets = tokens[:, 1:]
+    tok_logp = jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
+    sl = slice(prompt_len - 1, None)
+    return tok_logp[:, sl], values[:, sl]
+
+
+def ppo_loss(model: ActorCritic, params, rollout: Rollout,
+             cfg: PPOConfig, prompt_len: int):
+    """Clipped-ratio policy loss + clipped value loss + entropy.
+
+    Parity: ppo_util.py loss terms (the KL penalty is folded into
+    `rollout.rewards`, the TRL/reference convention).  `prompt_len` is
+    static (slice boundaries must be compile-time constants).
+    """
+    logp, values = _response_logprobs_values(model, params, rollout.tokens,
+                                             prompt_len)
+    ratio = jnp.exp(logp - rollout.logprobs)
+    adv = rollout.advantages
+    adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+    pg1 = -adv * ratio
+    pg2 = -adv * jnp.clip(ratio, 1 - cfg.clip_ratio, 1 + cfg.clip_ratio)
+    policy_loss = jnp.maximum(pg1, pg2).mean()
+    v_clipped = rollout.values + jnp.clip(
+        values - rollout.values, -cfg.value_clip, cfg.value_clip)
+    vf_loss = 0.5 * jnp.maximum(
+        (values - rollout.returns) ** 2,
+        (v_clipped - rollout.returns) ** 2).mean()
+    entropy = -(jnp.exp(logp) * logp).mean()
+    total = (policy_loss + cfg.vf_coef * vf_loss
+             - cfg.entropy_coef * entropy)
+    return total, {"policy_loss": policy_loss, "value_loss": vf_loss,
+                   "ratio": ratio.mean()}
+
+
+class ReplayBuffer:
+    """Host-side rollout store (parity rl replay buffer)."""
+
+    def __init__(self, capacity: int = 64):
+        self._items: List[Rollout] = []
+        self.capacity = capacity
+
+    def add(self, r: Rollout):
+        self._items.append(r)
+        if len(self._items) > self.capacity:
+            self._items.pop(0)
+
+    def sample_all(self) -> List[Rollout]:
+        return list(self._items)
+
+    def clear(self):
+        self._items.clear()
+
+    def __len__(self):
+        return len(self._items)
+
+
+class PPOTrainer:
+    """actor-critic + frozen reference + reward fn → PPO updates.
+
+    reward_fn(tokens (B, P+N) np.ndarray, prompt_len) -> (B,) np.ndarray
+    of sequence-level rewards (assigned to the last response token,
+    reference convention).
+    """
+
+    def __init__(self, cfg: GPTConfig, ppo: PPOConfig,
+                 reward_fn: Callable, seed: int = 0):
+        self.model_cfg = cfg
+        self.ppo = ppo
+        self.reward_fn = reward_fn
+        self.model = ActorCritic(cfg)
+        key = jax.random.PRNGKey(seed)
+        self.params = self.model.init_params(key)
+        self.ref_params = jax.tree.map(jnp.copy, self.params["gpt"])
+        self.opt = optax.adam(ppo.lr)
+        self.opt_state = self.opt.init(self.params)
+        self._rng = jax.random.PRNGKey(seed + 1)
+        self.buffer = ReplayBuffer()
+
+        ppo_cfg = self.ppo
+
+        import functools
+
+        @functools.partial(jax.jit, static_argnums=(3,))
+        def _update(params, opt_state, rollout: Rollout, prompt_len: int):
+            (loss, aux), grads = jax.value_and_grad(
+                lambda p: ppo_loss(self.model, p, rollout, ppo_cfg,
+                                   prompt_len),
+                has_aux=True)(params)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss, \
+                aux
+
+        self._update = _update
+
+    # --------------------------------------------------------------- rollout
+
+    def make_rollout(self, prompts: jax.Array) -> Rollout:
+        self._rng, sub = jax.random.split(self._rng)
+        sample = SampleConfig(max_new_tokens=self.ppo.max_new_tokens,
+                              temperature=self.ppo.temperature)
+        tokens, logprobs = generate(self.model_cfg, self.params["gpt"],
+                                    prompts, sub, sample)
+        P = prompts.shape[1]
+        ref_logp, _ = _response_logprobs_values(
+            self.model, dict(self.params, gpt=self.ref_params), tokens, P)
+        _, values = _response_logprobs_values(self.model, self.params,
+                                              tokens, P)
+        env_reward = jnp.asarray(
+            self.reward_fn(np.asarray(tokens), P), jnp.float32)
+        # KL penalty per token + terminal env reward (reference convention)
+        kl = logprobs - ref_logp
+        rewards = -self.ppo.kl_coef * kl
+        rewards = rewards.at[:, -1].add(env_reward)
+        advs, rets = gae_advantages(rewards, values, self.ppo.gamma,
+                                    self.ppo.lam)
+        roll = Rollout(tokens=tokens, logprobs=logprobs,
+                       ref_logprobs=ref_logp, values=values,
+                       rewards=rewards,
+                       advantages=jax.lax.stop_gradient(advs),
+                       returns=jax.lax.stop_gradient(rets),
+                       prompt_len=P)
+        self.buffer.add(roll)
+        return roll
+
+    # ----------------------------------------------------------------- train
+
+    def step(self, prompts: jax.Array) -> Dict[str, float]:
+        """One PPO iteration: rollout + ppo_epochs of updates."""
+        roll = self.make_rollout(prompts)
+        out = {}
+        for _ in range(self.ppo.ppo_epochs):
+            self.params, self.opt_state, loss, aux = self._update(
+                self.params, self.opt_state, roll, roll.prompt_len)
+        out["loss"] = float(loss)
+        out["reward"] = float(roll.rewards.sum(axis=1).mean())
+        out["kl"] = float((roll.logprobs - roll.ref_logprobs).mean())
+        for k, v in aux.items():
+            out[k] = float(v)
+        return out

@@ -1,0 +1,230 @@
+"""High-level Trainer — one object from model to trained checkpoint.
+
+Parity: reference `atorch/atorch/trainer/atorch_trainer.py:136`
+(`AtorchTrainer`, the HF-Trainer-style loop over auto_accelerate) and
+`atorch_args.py` (TrainingArgs).
+
+Composes the whole stack: `auto_accelerate` (strategy → compiled sharded
+step), elastic context (rendezvous world + dynamic sharding when launched
+by the agent), flash checkpoint (auto-resume + save cadence +
+save-on-exit), the step profiler (always-on timing + windowed traces), lr
+schedules, and periodic evaluation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Any, Callable, Dict, Iterable, Optional
+
+import numpy as np
+
+from ..common.log import get_logger
+
+logger = get_logger("trainer")
+
+
+@dataclasses.dataclass
+class TrainingArgs:
+    """Parity: reference atorch_args.py — the knobs of the training loop."""
+
+    output_dir: str = "/tmp/dwt-run"
+    max_steps: int = 1000
+    global_batch_size: int = 32
+    seq_len: int = 1024
+    learning_rate: float = 3e-4
+    weight_decay: float = 0.1
+    warmup_steps: int = 100
+    lr_schedule: str = "cosine"  # "cosine" | "linear" | "constant"
+    min_lr_ratio: float = 0.1
+    grad_accum_steps: int = 1
+    strategy: Optional[list] = None          # auto_accelerate strategy
+    logging_steps: int = 10
+    save_steps: int = 200
+    eval_steps: int = 0                      # 0 = no periodic eval
+    max_eval_batches: int = 32
+    seed: int = 0
+    resume: bool = True                      # auto-resume from output_dir
+    profile_trace_dir: str = ""              # jax.profiler window target
+    profile_start_step: int = -1
+    profile_end_step: int = -1
+    save_on_exit: bool = True
+
+
+class Trainer:
+    """HF-style: Trainer(model, args, train_data[, eval_data]).train().
+
+    `train_data` / `eval_data`: iterables yielding host batches — dicts of
+    arrays shaped (global_batch, ...) — or callables `(step) -> batch`
+    (useful for synthetic/streaming data).
+    """
+
+    def __init__(self, model, args: TrainingArgs,
+                 train_data: Any, eval_data: Any = None,
+                 optimizer=None, loss_fn: Optional[Callable] = None,
+                 callbacks: Optional[list] = None):
+        import optax
+
+        self.model = model
+        self.args = args
+        self.train_data = train_data
+        self.eval_data = eval_data
+        self.callbacks = callbacks or []
+        self._loss_fn = loss_fn
+
+        # elastic context: no-op when not launched by the agent
+        from .elastic import init_elastic
+
+        self.ctx = init_elastic()
+
+        schedule = self._make_schedule(optax)
+        self.optimizer = optimizer or optax.chain(
+            optax.clip_by_global_norm(1.0),
+            optax.adamw(schedule, weight_decay=args.weight_decay))
+
+        from ..auto.accelerate import auto_accelerate
+
+        self.res = auto_accelerate(
+            model, optimizer=self.optimizer, strategy=args.strategy,
+            loss_fn=loss_fn, accum_steps=args.grad_accum_steps,
+            seq_len=args.seq_len)
+        self.state = self.res.state
+
+        from ..checkpoint.checkpointer import FlashCheckpointer
+
+        self.ckpt = FlashCheckpointer(
+            os.path.join(args.output_dir, "checkpoints"),
+            job_name=os.getenv("DWT_JOB_NAME", "dwt"))
+
+        from ..utils.profiler import StepProfiler
+
+        self.profiler = StepProfiler(
+            trace_dir=args.profile_trace_dir or None,
+            start_step=args.profile_start_step,
+            end_step=args.profile_end_step)
+
+    # ------------------------------------------------------------- schedule
+
+    def _make_schedule(self, optax):
+        a = self.args
+        peak = a.learning_rate
+        if a.lr_schedule == "constant":
+            return optax.linear_schedule(0.0, peak, max(1, a.warmup_steps))
+        decay_steps = max(1, a.max_steps - a.warmup_steps)
+        if a.lr_schedule == "linear":
+            decay = optax.linear_schedule(peak, peak * a.min_lr_ratio,
+                                          decay_steps)
+        else:
+            decay = optax.cosine_decay_schedule(
+                peak, decay_steps, alpha=a.min_lr_ratio)
+        warmup = optax.linear_schedule(0.0, peak, max(1, a.warmup_steps))
+        return optax.join_schedules([warmup, decay], [a.warmup_steps])
+
+    # ----------------------------------------------------------------- data
+
+    def _batch_at(self, source, step: int):
+        if callable(source):
+            return source(step)
+        if not hasattr(self, "_iters"):
+            self._iters = {}
+        it = self._iters.get(id(source))
+        if it is None:
+            it = iter(source)
+            self._iters[id(source)] = it
+        try:
+            return next(it)
+        except StopIteration:
+            it = iter(source)  # new epoch
+            self._iters[id(source)] = it
+            return next(it)
+
+    # ---------------------------------------------------------------- train
+
+    def train(self) -> Dict[str, float]:
+        import jax
+
+        a = self.args
+        start_step = 0
+        if a.resume:
+            restored = self.ckpt.load_checkpoint(self.state)
+            if restored is not None:
+                self.state = restored
+                start_step = int(np.asarray(
+                    jax.tree.leaves(self.state.step)[0]))
+                logger.info("resumed from step %d", start_step)
+
+        last_loss = float("nan")
+        t_log = time.time()
+        tokens_per_step = a.global_batch_size * a.seq_len
+        try:
+            for step in range(start_step, a.max_steps):
+                batch = self.res.place_batch(
+                    dict(self._batch_at(self.train_data, step)))
+                with self.profiler.step(step):
+                    self.state, metrics = self.res.train_step(self.state,
+                                                              batch)
+                if a.logging_steps and (step + 1) % a.logging_steps == 0:
+                    last_loss = float(metrics["loss"])
+                    dt = time.time() - t_log
+                    t_log = time.time()
+                    tps = a.logging_steps * tokens_per_step / max(dt, 1e-9)
+                    logger.info("step %d loss=%.4f tokens/s=%.0f",
+                                step + 1, last_loss, tps)
+                    self.ctx.report_step(step + 1)
+                    for cb in self.callbacks:
+                        cb(step + 1, {"loss": last_loss,
+                                      "tokens_per_sec": tps})
+                if a.save_steps and (step + 1) % a.save_steps == 0:
+                    self._save(step + 1)
+                if a.eval_steps and self.eval_data is not None and \
+                        (step + 1) % a.eval_steps == 0:
+                    eval_loss = self.evaluate()
+                    logger.info("step %d eval_loss=%.4f", step + 1,
+                                eval_loss)
+        finally:
+            if a.save_on_exit:
+                self._save(int(np.asarray(
+                    jax.tree.leaves(self.state.step)[0])))
+                self.ckpt.wait_latest_checkpoint(600)
+            self.profiler.close()
+        if last_loss != last_loss:  # only short runs never logged
+            last_loss = float(metrics["loss"])
+        return {"final_step": a.max_steps, "final_loss": last_loss}
+
+    def _save(self, step: int):
+        from ..checkpoint.checkpointer import StorageType
+
+        blocked = self.ckpt.save_checkpoint(
+            step, self.state, storage_type=StorageType.DISK)
+        logger.info("checkpoint step %d staged (blocked %.3fs)", step,
+                    blocked)
+
+    # ----------------------------------------------------------------- eval
+
+    def evaluate(self) -> float:
+        """Mean loss over up to max_eval_batches of eval_data."""
+        import jax
+
+        if self.eval_data is None:
+            raise ValueError("no eval_data")
+        if not hasattr(self, "_eval_fn"):
+            loss_fn = self.res.loss_fn
+
+            @jax.jit
+            def _eval(params, batch):
+                return loss_fn(params, batch)
+
+            self._eval_fn = _eval
+        params = getattr(self.state, "params", None)
+        if params is None:  # DiLoCo state: evaluate the synced outer params
+            params = self.state.outer_params
+        losses = []
+        for i in range(self.args.max_eval_batches):
+            try:
+                batch = self.res.place_batch(
+                    dict(self._batch_at(self.eval_data, i)))
+            except StopIteration:  # pragma: no cover
+                break
+            losses.append(float(self._eval_fn(params, batch)))
+        return float(np.mean(losses)) if losses else float("nan")

@@ -1,0 +1,130 @@
+"""Diagnosis inference-chain tests.
+
+Mirrors reference `dlrover/python/tests/test_diagnosis.py`: symptom →
+cause refinement, straggler detection, OOM-precursor trend, and the
+coupling of conclusions into the job manager's restart machinery.
+"""
+
+import json
+import time
+
+from dlrover_wuqiong_tpu.common import messages as msg
+from dlrover_wuqiong_tpu.common.constants import NodeStatus, NodeType
+from dlrover_wuqiong_tpu.diagnosis.manager import (
+    CheckMemoryTrendOperator,
+    CheckStragglerOperator,
+    CheckTrainingHangOperator,
+    DiagnosisDataManager,
+    DiagnosisManager,
+    InferenceChain,
+    ResolveHangCauseOperator,
+)
+from dlrover_wuqiong_tpu.master.job_manager import JobManager
+
+
+def _step(data, node, ts):
+    data.store_report(msg.DiagnosisReport(node_id=node, payload_type="step",
+                                          content="s", timestamp=ts))
+
+
+def _resource(data, node, ts, mem):
+    data.store_report(msg.DiagnosisReport(
+        node_id=node, payload_type="resource",
+        content=json.dumps({"memory_mb": mem}), timestamp=ts))
+
+
+class TestHangChain:
+    def test_hang_refined_to_culprit(self):
+        data = DiagnosisDataManager()
+        now = time.time()
+        # node 0 stalled 100s before node 1; both silent past the timeout
+        _step(data, 0, now - 200)
+        _step(data, 1, now - 100)
+        data.store_report(msg.DiagnosisReport(
+            node_id=0, payload_type="stack", content="stuck in psum"))
+        chain = InferenceChain([CheckTrainingHangOperator(timeout=50),
+                                ResolveHangCauseOperator()])
+        conclusions = chain.run(data)
+        assert len(conclusions) == 1
+        c = conclusions[0]
+        assert c.name == "hang_culprit" and c.node_id == 0
+        assert "stack available" in c.detail
+
+    def test_no_hang_when_progressing(self):
+        data = DiagnosisDataManager()
+        _step(data, 0, time.time())
+        chain = InferenceChain([CheckTrainingHangOperator(timeout=50),
+                                ResolveHangCauseOperator()])
+        assert chain.run(data) == []
+
+
+class TestStraggler:
+    def test_slow_node_flagged(self):
+        data = DiagnosisDataManager()
+        base = time.time() - 1000
+        for i in range(10):
+            _step(data, 0, base + i * 1.0)   # 1s cadence
+            _step(data, 1, base + i * 1.1)
+            _step(data, 2, base + i * 10.0)  # 10x slower
+        out = CheckStragglerOperator(ratio=3.0).infer(data, [])
+        assert [c.node_id for c in out] == [2]
+        assert out[0].name == "straggler"
+
+    def test_uniform_cadence_clean(self):
+        data = DiagnosisDataManager()
+        base = time.time() - 100
+        for i in range(10):
+            for n in range(3):
+                _step(data, n, base + i * 1.0 + n * 0.01)
+        assert CheckStragglerOperator().infer(data, []) == []
+
+
+class TestMemoryTrend:
+    def test_over_limit_and_trend(self):
+        data = DiagnosisDataManager()
+        now = time.time()
+        # node 0 already over; node 1 trending 10MB/s toward 2000 limit
+        _resource(data, 0, now, 2500)
+        for i in range(5):
+            _resource(data, 1, now - 50 + i * 10, 1500 + i * 100)
+        op = CheckMemoryTrendOperator(memory_limit_mb=2000, horizon_s=600)
+        out = {c.node_id: c.name for c in op.infer(data, [])}
+        assert out[0] == "memory_over_limit"
+        assert out[1] == "memory_trend"
+
+
+class TestActionCoupling:
+    def test_restart_flag_set_on_hang(self):
+        jm = JobManager()
+        node = jm.register_node(NodeType.WORKER, 0)
+        node.update_status(NodeStatus.RUNNING)
+        dm = DiagnosisManager(hang_timeout=1, job_manager=jm)
+        _step(dm.data, 0, time.time() - 100)
+        actions = dm.diagnose_once()
+        assert any(a.action == "restart_worker" for a in actions)
+        assert node.restart_training  # delivered via next heartbeat
+        assert jm.collect_heartbeat(0) == "restart"
+
+    def test_memory_over_limit_relaunches(self):
+        jm = JobManager()
+        node = jm.register_node(NodeType.WORKER, 0)
+        node.update_status(NodeStatus.RUNNING)
+        before_mem = node.config_resource.memory_mb = 1000
+        dm = DiagnosisManager(hang_timeout=1e9, job_manager=jm)
+        dm.chain.operators[2] = CheckMemoryTrendOperator(
+            memory_limit_mb=2000)
+        _resource(dm.data, 0, time.time(), 2500)
+        dm.diagnose_once()
+        # OOM path: old node released, replacement registered w/ more memory
+        assert node.is_released
+        assert any(n.id != 0 and n.config_resource.memory_mb > before_mem
+                   for n in jm.all_nodes())
+
+    def test_worker_polls_pending_action(self):
+        dm = DiagnosisManager(hang_timeout=1)
+        _step(dm.data, 0, time.time() - 100)
+        dm.diagnose_once()
+        act = dm.collect_report(msg.DiagnosisReport(
+            node_id=0, payload_type="step", content="s",
+            timestamp=time.time()))
+        assert act.action == "restart_worker"

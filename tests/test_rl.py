@@ -1,0 +1,110 @@
+"""RLHF engine tests: KV-cache generation parity with the dense model,
+GAE math, PPO loss, and an end-to-end reward-climbing mini-RLHF run.
+
+Mirrors reference `atorch/tests/rl_tests/` in spirit.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_wuqiong_tpu.models.gpt import GPT, GPTConfig
+from dlrover_wuqiong_tpu.rl import (
+    ActorCritic,
+    PPOConfig,
+    PPOTrainer,
+    SampleConfig,
+    gae_advantages,
+    generate,
+)
+
+
+def _cfg(**kw):
+    return dataclasses.replace(
+        GPTConfig(vocab_size=64, n_layer=2, n_head=2, n_embd=32,
+                  block_size=64, dtype=jnp.float32,
+                  use_flash_attention=False, remat=False), **kw)
+
+
+class TestGeneration:
+    def test_cached_forward_matches_dense_model(self):
+        """Greedy decode with the KV cache must follow the dense model's
+        argmax continuation exactly."""
+        cfg = _cfg()
+        model = GPT(cfg)
+        params = model.init_params(jax.random.PRNGKey(0))
+        prompt = jnp.array([[1, 2, 3, 4]], jnp.int32)
+        toks, _ = generate(cfg, params, prompt, jax.random.PRNGKey(1),
+                           SampleConfig(max_new_tokens=6,
+                                        temperature=1e-6))  # ~greedy
+        # dense-model greedy reference
+        seq = prompt
+        for _ in range(6):
+            logits = model.apply({"params": params}, seq)
+            nxt = jnp.argmax(logits[:, -1], -1)[:, None]
+            seq = jnp.concatenate([seq, nxt.astype(seq.dtype)], axis=1)
+        np.testing.assert_array_equal(np.asarray(toks), np.asarray(seq))
+
+    def test_logprobs_match_sampled_tokens(self):
+        cfg = _cfg()
+        params = GPT(cfg).init_params(jax.random.PRNGKey(0))
+        prompt = jnp.ones((2, 3), jnp.int32)
+        toks, logps = generate(cfg, params, prompt, jax.random.PRNGKey(2),
+                               SampleConfig(max_new_tokens=5))
+        assert toks.shape == (2, 8)
+        assert logps.shape == (2, 5)
+        assert bool(jnp.all(logps <= 0))
+
+
+class TestGAE:
+    def test_terminal_only_reward(self):
+        rewards = jnp.zeros((1, 4)).at[0, -1].set(1.0)
+        values = jnp.zeros((1, 4))
+        adv, ret = gae_advantages(rewards, values, gamma=1.0, lam=1.0)
+        np.testing.assert_allclose(np.asarray(ret[0]), np.ones(4))
+
+    def test_lambda_zero_is_td(self):
+        rewards = jnp.array([[1.0, 0.0, 0.0]])
+        values = jnp.array([[0.5, 0.2, 0.1]])
+        adv, _ = gae_advantages(rewards, values, gamma=1.0, lam=0.0)
+        expected = np.array([1.0 + 0.2 - 0.5, 0.1 - 0.2, -0.1])
+        np.testing.assert_allclose(np.asarray(adv[0]), expected,
+                                   atol=1e-6)
+
+
+class TestPPOEndToEnd:
+    def test_reward_increases(self):
+        """Mini-RLHF: reward = fraction of TARGET tokens in the response.
+        PPO must push the policy toward emitting TARGET."""
+        TARGET = 7
+        cfg = _cfg()
+
+        def reward_fn(tokens, prompt_len):
+            resp = tokens[:, prompt_len:]
+            return (resp == TARGET).mean(axis=1).astype(np.float32) * 4.0
+
+        trainer = PPOTrainer(cfg, PPOConfig(
+            lr=1e-3, max_new_tokens=8, ppo_epochs=4, kl_coef=0.002),
+            reward_fn, seed=0)
+        prompts = jnp.ones((32, 4), jnp.int32)
+        rewards = []
+        for _ in range(12):
+            out = trainer.step(prompts)
+            rewards.append(out["reward"])
+        early = np.mean(rewards[:3])
+        late = np.mean(rewards[-3:])
+        assert late > early + 0.5, rewards
+
+    def test_actor_critic_shapes(self):
+        cfg = _cfg()
+        ac = ActorCritic(cfg)
+        params = ac.init_params(jax.random.PRNGKey(0))
+        logits, values = ac.apply({"params": params},
+                                  jnp.ones((2, 6), jnp.int32))
+        assert logits.shape == (2, 6, cfg.vocab_size)
+        assert values.shape == (2, 6)
+        # the trunk params live under "gpt" (generation reuses them as-is)
+        assert "wte" in params["gpt"]
