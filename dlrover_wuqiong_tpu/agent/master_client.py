@@ -78,10 +78,13 @@ class MasterClient:
                         rdzv_name: str = RendezvousName.ELASTIC_TRAINING,
                         node_ip: str = "127.0.0.1",
                         free_port: int = 0) -> int:
+        import os
+
         resp = self._client.report(msg.JoinRendezvousRequest(
             node_id=self.node_id, node_rank=node_rank,
             local_world_size=local_world_size, rdzv_name=rdzv_name,
-            node_ip=node_ip, free_port=free_port))
+            node_ip=node_ip, free_port=free_port,
+            slice_id=os.getenv("DWT_SLICE_ID", "")))
         return resp.rdzv_round
 
     def get_comm_world(

@@ -95,6 +95,7 @@ class JoinRendezvousRequest:
     rdzv_name: str = ""
     node_ip: str = ""
     free_port: int = 0
+    slice_id: str = ""  # TPU slice locality hint (DWT_SLICE_ID)
 
 
 @message

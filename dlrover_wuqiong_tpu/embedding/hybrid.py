@@ -1,0 +1,255 @@
+"""Hybrid (tiered) embedding storage: hot rows in HBM, cold rows on host.
+
+Parity: reference `tfplus/tfplus/kv_variable/kernels/hybrid_embedding/`
+(`StorageTableInterface`/`MemStorageTable` storage_table.h:41-164,
+`TableManager` table_manager.h — a primary table with an overflow tier and
+eviction between them).
+
+TPU redesign: the device value table (HBM) is the hot tier with a FIXED
+row budget; an on-host overflow store (numpy, optionally file-backed
+memmap) holds cold rows.  The host KvStore keeps mapping ids→hot slots;
+overflow rows live keyed by raw id.  On lookup, resident ids gather from
+HBM as usual; spilled ids are promoted back into hot slots (evicting the
+least-recently-seen residents to the overflow tier first), so the training
+step still sees one dense device table with static shapes.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..common.log import get_logger
+from .kv_embedding import _NULL_SLOT, KvEmbedding
+from .sparse_optim import SparseOptConfig
+
+logger = get_logger("hybrid_embedding")
+
+
+class OverflowStore:
+    """Cold tier: id → (value row, opt-state rows). In-memory dict of numpy
+    rows, optionally spilling the payload to a memmap directory.
+
+    Parity: MemStorageTable (storage_table.h:41) — the overflow table the
+    TableManager moves rows through.
+    """
+
+    def __init__(self, dim: int, state_keys: Tuple[str, ...],
+                 spill_dir: Optional[str] = None):
+        self.dim = dim
+        self.state_keys = state_keys
+        self._rows: Dict[int, Dict[str, np.ndarray]] = {}
+        self._spill_dir = spill_dir
+        if spill_dir:
+            os.makedirs(spill_dir, exist_ok=True)
+
+    def put(self, key: int, value: np.ndarray,
+            state: Dict[str, np.ndarray]):
+        entry = {"value": np.asarray(value, np.float32)}
+        for k in self.state_keys:
+            entry[k] = np.asarray(state[k], np.float32)
+        if self._spill_dir:
+            path = os.path.join(self._spill_dir, f"{key}.npz")
+            np.savez(path, **entry)
+            self._rows[key] = None  # marker: on disk
+        else:
+            self._rows[key] = entry
+
+    def get(self, key: int) -> Optional[Dict[str, np.ndarray]]:
+        if key not in self._rows:
+            return None
+        entry = self._rows[key]
+        if entry is None:  # spilled to disk
+            path = os.path.join(self._spill_dir, f"{key}.npz")
+            with np.load(path) as z:
+                entry = {k: z[k] for k in z.files}
+        return entry
+
+    def pop(self, key: int) -> Optional[Dict[str, np.ndarray]]:
+        entry = self.get(key)
+        if entry is not None:
+            self._rows.pop(key, None)
+            if self._spill_dir:
+                try:
+                    os.remove(os.path.join(self._spill_dir, f"{key}.npz"))
+                except OSError:
+                    pass
+        return entry
+
+    def __len__(self):
+        return len(self._rows)
+
+    def __contains__(self, key: int):
+        return key in self._rows
+
+
+class HybridKvEmbedding(KvEmbedding):
+    """KvEmbedding with a bounded hot tier + overflow spilling.
+
+    `max_hot_rows` caps the device table; when full, the least-recently-
+    seen resident rows are demoted to the overflow store to make room for
+    newly-promoted/inserted ids (TableManager eviction policy).
+
+    Recency uses a LOGICAL tick (one per lookup batch), not wall time, so
+    rows assigned earlier in the CURRENT batch can never be demoted to
+    make room for later ids of the same batch (second-granularity
+    timestamps tie and would alias two batch ids onto one row).
+    `evict_older_than` thresholds are therefore ticks on this class.
+    """
+
+    def __init__(self, dim: int, max_hot_rows: int = 1024,
+                 spill_dir: Optional[str] = None,
+                 optimizer: Optional[SparseOptConfig] = None, **kw):
+        super().__init__(dim, capacity=max_hot_rows, optimizer=optimizer,
+                         **kw)
+        self.max_hot_rows = max_hot_rows
+        self.overflow = OverflowStore(
+            dim, tuple(self.slot_state), spill_dir)
+        self._tick = 1
+
+    def grow(self, new_capacity: int):
+        """Insert pressure spills to the overflow instead of growing —
+        unless nothing is demotable (everything belongs to the current
+        batch), where growing is the only correct move."""
+        demoted = self._demote_cold(max(1, self.max_hot_rows // 8))
+        if demoted == 0:
+            self._grow_hot(new_capacity)
+
+    def _grow_hot(self, new_capacity: int):
+        KvEmbedding.grow(self, new_capacity)
+        self.max_hot_rows = max(self.max_hot_rows, new_capacity)
+
+    def _demote_cold(self, n: int) -> int:
+        """Move the n least-recently-seen resident rows to the overflow.
+
+        Rows touched in the current batch (ts == current tick) are never
+        demoted; value AND optimizer-state rows are zeroed so a future
+        occupant of the recycled slot starts clean.
+        """
+        keys, slots, freqs, tss = self.store.export(with_meta=True)
+        order = np.argsort(tss, kind="stable")
+        values = np.asarray(self.values)
+        state_np = {k: np.asarray(v) for k, v in self.slot_state.items()}
+        demote_keys, freed = [], []
+        for i in order:
+            if len(demote_keys) >= n:
+                break
+            key, slot = int(keys[i]), int(slots[i])
+            if slot == _NULL_SLOT or int(tss[i]) >= self._tick:
+                continue
+            self.overflow.put(key, values[slot],
+                              {k: v[slot] for k, v in state_np.items()})
+            demote_keys.append(key)
+            freed.append(slot)
+        if demote_keys:
+            import jax.numpy as jnp
+
+            self.store.remove(np.array(demote_keys, np.int64))
+            idx = np.array(freed)
+            self.values = self.values.at[idx].set(
+                jnp.zeros((len(freed), self.dim), self.values.dtype))
+            for k, v in self.slot_state.items():
+                self.slot_state[k] = v.at[idx].set(0)
+            logger.info("demoted %d cold rows to overflow (%d held)",
+                        len(demote_keys), len(self.overflow))
+        return len(demote_keys)
+
+    def lookup_slots(self, ids: np.ndarray, insert: bool = True,
+                     train: bool = True) -> np.ndarray:
+        """Promote spilled ids back into the hot tier before lookup."""
+        import jax.numpy as jnp
+
+        self._tick += 1
+        ids = np.ascontiguousarray(ids, np.int64)
+        spilled = [int(i) for i in np.unique(ids)
+                   if i in self.overflow]
+        for key in spilled:
+            entry = self.overflow.pop(key)
+            slot = int(self._base_lookup(np.array([key], np.int64))[0])
+            if slot == _NULL_SLOT:
+                continue
+            self.values = self.values.at[slot].set(
+                jnp.asarray(entry["value"], self.values.dtype))
+            for k in self.slot_state:
+                if k in entry:
+                    self.slot_state[k] = self.slot_state[k].at[slot].set(
+                        jnp.asarray(entry[k], self.slot_state[k].dtype))
+        return self._base_lookup(ids, insert=insert, train=train)
+
+    def _base_lookup(self, ids, insert: bool = True, train: bool = True):
+        """KvEmbedding.lookup_slots with the logical tick as `now`."""
+        ids = np.ascontiguousarray(ids, np.int64)
+        if insert:
+            slots, _ = self.store.lookup_or_insert(
+                ids, now=self._tick,
+                grow_fn=lambda: self.grow(self.store.capacity * 2))
+        else:
+            slots = self.store.lookup(ids)
+            slots = np.where(slots < 0, _NULL_SLOT, slots)
+        if self.min_freq > 1 and train:
+            freq = self.store.freq(slots)
+            slots = np.where(freq >= self.min_freq, slots, _NULL_SLOT)
+        return slots
+
+    # ------------------------------------------------------ import / export
+
+    def export_full(self):
+        """Hot tier + every overflow row (slot -1 marks non-resident)."""
+        blob = super().export_full()
+        extra_keys, extra_vals = [], []
+        extra_state = {k: [] for k in self.slot_state}
+        for key in list(self.overflow._rows):
+            entry = self.overflow.get(key)
+            if entry is None:
+                continue
+            extra_keys.append(key)
+            extra_vals.append(entry["value"])
+            for k in extra_state:
+                extra_state[k].append(entry.get(
+                    k, np.zeros_like(entry["value"])))
+        if extra_keys:
+            blob["keys"] = np.concatenate(
+                [blob["keys"], np.array(extra_keys, np.int64)])
+            blob["slots"] = np.concatenate(
+                [blob["slots"], np.full(len(extra_keys), -1, np.int64)])
+            blob["freqs"] = np.concatenate(
+                [blob["freqs"], np.ones(len(extra_keys), np.uint32)])
+            blob["tss"] = np.concatenate(
+                [blob["tss"], np.zeros(len(extra_keys), np.uint32)])
+            blob["values"] = np.concatenate(
+                [blob["values"], np.stack(extra_vals)])
+            for k in extra_state:
+                blob[f"opt_{k}"] = np.concatenate(
+                    [blob[f"opt_{k}"], np.stack(extra_state[k])])
+        return blob
+
+    def export_delta(self):
+        """Store delta + ALL overflow rows (a demoted row's dirty bit died
+        with its mapping; including the cold tier keeps deltas lossless at
+        the cost of their size)."""
+        blob, epoch = super().export_delta()
+        full = self.export_full()
+        cold = full["slots"] == -1
+        if cold.any():
+            for k in blob:
+                blob[k] = np.concatenate([blob[k], full[k][cold]])
+        return blob, epoch
+
+    def import_full(self, blob):
+        cold = blob["slots"] == -1
+        hot = ~cold
+        hot_blob = {k: v[hot] for k, v in blob.items()}
+        if len(hot_blob["slots"]):
+            needed = int(np.max(hot_blob["slots"])) + 1
+            if needed > self.store.capacity:
+                # explicit slot demands (restore) must really grow the
+                # hot tier — demotion can't satisfy a slot index
+                self._grow_hot(max(needed, self.store.capacity * 2))
+        super().import_full(hot_blob)
+        for i in np.nonzero(cold)[0]:
+            self.overflow.put(
+                int(blob["keys"][i]), blob["values"][i],
+                {k: blob[f"opt_{k}"][i] for k in self.slot_state
+                 if f"opt_{k}" in blob})

@@ -92,6 +92,8 @@ def _load(so: str) -> ctypes.CDLL:
     lib.kv_evict_older_than.restype = ctypes.c_int64
     lib.kv_evict_older_than.argtypes = [ctypes.c_void_p, ctypes.c_uint32,
                                         i64p, ctypes.c_int64]
+    lib.kv_remove.restype = ctypes.c_int64
+    lib.kv_remove.argtypes = [ctypes.c_void_p, i64p, ctypes.c_int64]
     lib.kv_export.restype = ctypes.c_int64
     lib.kv_export.argtypes = [ctypes.c_void_p, i64p, i64p, u32p, u32p,
                               ctypes.c_int64]
@@ -185,6 +187,12 @@ class NativeKvStore:
         n = self._lib.kv_evict_older_than(self._h, ts_threshold & 0xFFFFFFFF,
                                           _i64(out), max_out)
         return out[:min(n, max_out)].copy()
+
+    def remove(self, keys: np.ndarray) -> int:
+        """Delete specific keys, recycling their slots."""
+        keys = np.ascontiguousarray(keys, np.int64)
+        return int(self._lib.kv_remove(self._h, _i64(keys.ravel()),
+                                       keys.size))
 
     def export(self, with_meta: bool = True):
         """Returns (keys, slots[, freqs, tss])."""
@@ -326,6 +334,17 @@ class PyKvStore:
                 self._free.append(s)
                 out.append(s)
         return np.array(out, np.int64)
+
+    def remove(self, keys) -> int:
+        removed = 0
+        with self._lock:
+            for k in np.ascontiguousarray(keys, np.int64).ravel().tolist():
+                s = self._map.pop(int(k), None)
+                if s is not None:
+                    self._freq[s] = 0
+                    self._free.append(s)
+                    removed += 1
+        return removed
 
     def export(self, with_meta=True):
         keys = np.array(list(self._map.keys()), np.int64)

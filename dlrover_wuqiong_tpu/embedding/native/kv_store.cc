@@ -164,6 +164,28 @@ class KvStore {
     capacity_ = new_capacity;
   }
 
+  // Remove specific keys (parity: KvVariable delete ops); recycles slots.
+  // Returns the number actually removed.
+  int64_t remove_keys(const int64_t* keys, int64_t n) {
+    int64_t removed = 0;
+    for (int64_t i = 0; i < n; ++i) {
+      Shard& s = shard_for(keys[i]);
+      std::unique_lock<std::shared_mutex> wl(s.mu);
+      auto it = s.map.find(keys[i]);
+      if (it == s.map.end()) continue;
+      int64_t slot = it->second;
+      slot_key_[slot] = -1;
+      freq_[slot].store(0, std::memory_order_relaxed);
+      {
+        std::lock_guard<std::mutex> g(free_mu_);
+        free_slots_.push_back(slot);
+      }
+      s.map.erase(it);
+      ++removed;
+    }
+    return removed;
+  }
+
   // Remove keys last seen strictly before `ts_threshold`; recycles slots.
   // Parity: KvVariableDeleteWithTimestamp (ops/kv_variable_ops.cc).
   int64_t evict_older_than(uint32_t ts_threshold, int64_t* evicted_slots,
@@ -354,6 +376,12 @@ int64_t kv_evict_older_than(void* h, uint32_t ts, int64_t* slots,
   auto* st = static_cast<KvStore*>(h);
   std::shared_lock<std::shared_mutex> g(st->global_mu());
   return st->evict_older_than(ts, slots, max_out);
+}
+
+int64_t kv_remove(void* h, const int64_t* keys, int64_t n) {
+  auto* st = static_cast<KvStore*>(h);
+  std::shared_lock<std::shared_mutex> g(st->global_mu());
+  return st->remove_keys(keys, n);
 }
 
 int64_t kv_export(void* h, int64_t* keys, int64_t* slots, uint32_t* freqs,

@@ -29,11 +29,13 @@ class NodeSpec:
     """What a node declares when joining."""
 
     def __init__(self, node_id: int, node_rank: int, local_world_size: int,
-                 node_ip: str = "", free_port: int = 0):
+                 node_ip: str = "", free_port: int = 0,
+                 slice_id: str = ""):
         self.node_id = node_id
         self.node_rank = node_rank
         self.local_world_size = local_world_size
         self.node_ip = node_ip
+        self.slice_id = slice_id
         self.free_port = free_port
         self.join_time = time.time()
 
@@ -89,12 +91,13 @@ class RendezvousManager(ABC):
 
     def join_rendezvous(self, node_id: int, node_rank: int,
                         local_world_size: int, node_ip: str = "",
-                        free_port: int = 0) -> int:
+                        free_port: int = 0, slice_id: str = "") -> int:
         """Register a node as waiting; returns the current round."""
         with self._lock:
             if node_id not in self._waiting_nodes:
                 self._waiting_nodes[node_id] = NodeSpec(
-                    node_id, node_rank, local_world_size, node_ip, free_port)
+                    node_id, node_rank, local_world_size, node_ip,
+                    free_port, slice_id)
                 if not self._start_rdzv_ts:
                     self._start_rdzv_ts = time.time()
                 logger.info(
@@ -126,8 +129,18 @@ class RendezvousManager(ABC):
         return (time.time() - self._start_rdzv_ts) > self._params.waiting_timeout
 
     def _form_world(self):
+        # topology-aware ordering: same-slice/subnet nodes get contiguous
+        # ranks so inner mesh axes ride ICI (master/net_topology.py)
+        from .net_topology import DpTopologySorter, NodeTopologyMeta
+
+        metas = [NodeTopologyMeta(node_id=s.node_id, node_rank=s.node_rank,
+                                  ip=getattr(s, "node_ip", ""),
+                                  slice_id=getattr(s, "slice_id", ""))
+                 for s in self._waiting_nodes.values()]
+        order = {m.node_id: i for i, m in
+                 enumerate(DpTopologySorter().sort(metas))}
         specs = sorted(self._waiting_nodes.values(),
-                       key=lambda s: (s.node_rank, s.node_id))
+                       key=lambda s: order[s.node_id])
         n = len(specs)
         if n > self._params.max_nodes:
             specs = specs[: self._params.max_nodes]
@@ -248,7 +261,7 @@ class NetworkCheckRendezvousManager(RendezvousManager):
 
     def join_rendezvous(self, node_id: int, node_rank: int,
                         local_world_size: int, node_ip: str = "",
-                        free_port: int = 0) -> int:
+                        free_port: int = 0, slice_id: str = "") -> int:
         with self._lock:
             if not self._waiting_nodes:
                 # starting a fresh check sweep
@@ -257,7 +270,7 @@ class NetworkCheckRendezvousManager(RendezvousManager):
                 self._fault_nodes.clear()
                 self._stragglers.clear()
         return super().join_rendezvous(node_id, node_rank, local_world_size,
-                                       node_ip, free_port)
+                                       node_ip, free_port, slice_id)
 
     def network_check_success(self) -> Tuple[bool, str]:
         """All nodes reported and none faulty."""

@@ -108,7 +108,7 @@ class MasterServicer:
             rdzv = m.rdzv_managers.get(payload.rdzv_name)
             rdzv_round = rdzv.join_rendezvous(
                 payload.node_id, payload.node_rank, payload.local_world_size,
-                payload.node_ip, payload.free_port)
+                payload.node_ip, payload.free_port, payload.slice_id)
             m.job_manager.register_node("worker", payload.node_id,
                                         rank_index=payload.node_rank)
             m.job_manager.collect_heartbeat(payload.node_id)

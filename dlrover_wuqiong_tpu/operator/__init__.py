@@ -1,0 +1,27 @@
+"""ElasticJob operator: CRD contract + Python reconcile controller.
+
+Parity axis: the reference Go operator (dlrover/go/operator) — see crd.py
+for the API contract and controller.py for the reconcile loop.
+"""
+
+from .controller import ElasticJobController, InMemoryJobStore, JobStore
+from .crd import (
+    ElasticJob,
+    ElasticJobSpec,
+    JobPhase,
+    ReplicaSpec,
+    ScalePlan,
+    elasticjob_crd_manifest,
+)
+
+__all__ = [
+    "ElasticJobController",
+    "InMemoryJobStore",
+    "JobStore",
+    "ElasticJob",
+    "ElasticJobSpec",
+    "JobPhase",
+    "ReplicaSpec",
+    "ScalePlan",
+    "elasticjob_crd_manifest",
+]

@@ -1,0 +1,212 @@
+"""ElasticJob controller: reconcile CRs into running jobs.
+
+Parity: reference `dlrover/go/operator/pkg/controllers/
+elasticjob_controller.go:85` (`Reconcile` — create the master pod, then
+delegate node lifecycle to the master) and `scaleplan_controller.go`
+(forward ScalePlan CRs to the job).
+
+Python redesign (SURVEY.md §7 item 7): a kopf-style reconcile loop over a
+pluggable API client.  The controller creates exactly ONE thing per job —
+the master (as a pod via the scheduler client, or a local process in
+tests) — then watches job phase; pod CRUD for workers stays with the
+master's own scaler, exactly like the reference's division of labor.
+ScalePlans forward to the master's RPC as a paral-config/replica update.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..common.log import get_logger
+from ..scheduler.base import NodeSpec, SchedulerClient
+from .crd import ElasticJob, JobPhase, ScalePlan
+
+logger = get_logger("operator")
+
+
+class JobStore:
+    """Source of ElasticJob/ScalePlan objects + status writeback.
+
+    The k8s implementation lists/watches the CRs through the API server;
+    the in-memory implementation backs tests and local mode.
+    """
+
+    def list_jobs(self) -> List[ElasticJob]:
+        raise NotImplementedError
+
+    def pop_scale_plans(self) -> List[ScalePlan]:
+        raise NotImplementedError
+
+    def update_status(self, job: ElasticJob):
+        raise NotImplementedError
+
+
+class InMemoryJobStore(JobStore):
+    def __init__(self):
+        self._jobs: Dict[str, ElasticJob] = {}
+        self._plans: List[ScalePlan] = []
+        self._lock = threading.Lock()
+
+    def submit(self, job: ElasticJob):
+        with self._lock:
+            self._jobs[job.name] = job
+
+    def submit_scale_plan(self, plan: ScalePlan):
+        with self._lock:
+            self._plans.append(plan)
+
+    def list_jobs(self) -> List[ElasticJob]:
+        with self._lock:
+            return list(self._jobs.values())
+
+    def pop_scale_plans(self) -> List[ScalePlan]:
+        with self._lock:
+            plans, self._plans = self._plans, []
+            return plans
+
+    def update_status(self, job: ElasticJob):
+        with self._lock:
+            self._jobs[job.name] = job
+
+
+class ElasticJobController:
+    """The reconcile loop.
+
+    master_factory(job) -> master handle with .addr, .poll() (None while
+    running, exit code when done) and .scale(replica_counts).  The default
+    factory launches a master node through the scheduler client.
+    """
+
+    MASTER_TYPE = "master"
+
+    def __init__(self, store: JobStore,
+                 scheduler_client: Optional[SchedulerClient] = None,
+                 master_factory: Optional[Callable] = None,
+                 interval: float = 2.0):
+        self.store = store
+        self.client = scheduler_client
+        self.master_factory = master_factory or self._launch_master_pod
+        self.interval = interval
+        self._masters: Dict[str, object] = {}
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- reconcile
+
+    def reconcile_once(self):
+        """One pass: converge every job toward its desired state.
+
+        Parity: Reconcile (elasticjob_controller.go:85) — idempotent;
+        `createEasydlMaster` (:182) happens at most once per job.
+        """
+        for job in self.store.list_jobs():
+            try:
+                self._reconcile_job(job)
+            except Exception:  # noqa: BLE001
+                logger.exception("reconcile of %s failed", job.name)
+        for plan in self.store.pop_scale_plans():
+            master = self._masters.get(plan.job_name)
+            if master is None:
+                logger.warning("scale plan for unknown job %s",
+                               plan.job_name)
+                continue
+            try:
+                master.scale(plan.replica_counts)
+                logger.info("scale plan applied to %s: %s", plan.job_name,
+                            plan.replica_counts)
+            except Exception:  # noqa: BLE001
+                logger.exception("scale plan for %s failed", plan.job_name)
+
+    def _reconcile_job(self, job: ElasticJob):
+        master = self._masters.get(job.name)
+        if master is None and job.phase in (JobPhase.PENDING,):
+            master = self.master_factory(job)
+            self._masters[job.name] = master
+            job.phase = JobPhase.LAUNCHING
+            job.master_addr = getattr(master, "addr", "")
+            self.store.update_status(job)
+            logger.info("job %s: master created at %s", job.name,
+                        job.master_addr)
+            return
+        if master is None:
+            return
+        code = master.poll()
+        if code is None:
+            if job.phase == JobPhase.LAUNCHING:
+                job.phase = JobPhase.RUNNING
+                self.store.update_status(job)
+            return
+        job.phase = JobPhase.SUCCEEDED if code == 0 else JobPhase.FAILED
+        self.store.update_status(job)
+        self._masters.pop(job.name, None)
+        logger.info("job %s finished: %s", job.name, job.phase)
+
+    def _launch_master_pod(self, job: ElasticJob):
+        """Default factory: the master runs as a pod of the job.
+
+        Parity: controllers/master/master.go — the one pod the operator
+        itself creates.
+        """
+        if self.client is None:
+            raise RuntimeError("no scheduler client for master launch")
+        worker_spec = job.spec.replica_specs.get("worker")
+        replicas = worker_spec.replicas if worker_spec else 1
+        # one client may serve several jobs: the node id identifies WHOSE
+        # master this is (stable hash of the job name)
+        node_id = abs(hash(job.name)) % (1 << 31)
+        spec = NodeSpec(
+            node_type=self.MASTER_TYPE, node_id=node_id,
+            command=["python", "-c",
+                     "from dlrover_wuqiong_tpu.master.master import "
+                     "run_master_forever; "
+                     f"run_master_forever(0, {replicas}, {replicas})"],
+            env={"DWT_JOB_NAME": job.name})
+        if not self.client.create_node(spec):
+            raise RuntimeError("master create failed")
+        client = self.client
+        name = job.name
+
+        class _Handle:
+            addr = ""
+            _missing = 0
+
+            def poll(self):
+                from ..common.constants import NodeStatus
+
+                for node in client.list_nodes():
+                    if node.type == ElasticJobController.MASTER_TYPE \
+                            and node.id == node_id:
+                        self._missing = 0
+                        if node.status == NodeStatus.SUCCEEDED:
+                            return 0
+                        if node.status == NodeStatus.FAILED:
+                            return 1
+                        return None
+                # a real watch/list can lag the create by a tick — only a
+                # persistently-absent pod means the master died
+                self._missing += 1
+                return 1 if self._missing >= 3 else None
+
+            def scale(self, replica_counts):
+                logger.info("job %s scale request: %s", name,
+                            replica_counts)
+
+        return _Handle()
+
+    # ------------------------------------------------------------------ loop
+
+    def start(self):
+        def _loop():
+            while not self._stopped.wait(self.interval):
+                self.reconcile_once()
+
+        self._thread = threading.Thread(target=_loop, daemon=True,
+                                        name="dwt-operator")
+        self._thread.start()
+
+    def stop(self):
+        self._stopped.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

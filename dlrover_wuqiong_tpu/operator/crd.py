@@ -1,0 +1,154 @@
+"""ElasticJob / ScalePlan custom-resource contract.
+
+Parity: reference `dlrover/go/operator/api/v1alpha1/elasticjob_types.go:29-127`
+(ElasticJobSpec: DistributionStrategy, OptimizeMode, EnableElasticScheduling,
+EnableDynamicSharding, ReplicaSpecs; status phases) and
+`scaleplan_controller.go` (ScalePlanSpec).
+
+The Go operator's CRDs are a k8s API contract, not compute — here they are
+dataclasses + generated CRD manifests so (a) the Python controller
+(`controller.py`) reconciles the same objects, and (b) a cluster admin can
+`kubectl apply` the schema and submit the same YAML a reference user would.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+GROUP = "elastic.dwt.ai"
+VERSION = "v1alpha1"
+
+
+class OptimizeMode:
+    MANUAL = "manual"
+    SINGLE_JOB = "single-job"
+    CLUSTER = "cluster"
+
+
+class JobPhase:
+    PENDING = "Pending"
+    LAUNCHING = "Launching"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+    SCALING = "Scaling"
+
+
+@dataclasses.dataclass
+class ReplicaSpec:
+    """One node group (parity ReplicaSpec: replicas + pod template)."""
+
+    replicas: int = 1
+    min_replicas: int = 0
+    max_replicas: int = 0
+    cpu: float = 0.0
+    memory_mb: float = 0.0
+    image: str = ""
+    command: Optional[List[str]] = None
+
+    def to_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "ReplicaSpec":
+        return cls(**{k: v for k, v in d.items()
+                      if k in {f.name for f in dataclasses.fields(cls)}})
+
+
+@dataclasses.dataclass
+class ElasticJobSpec:
+    """Parity elasticjob_types.go:29 (the fields the TPU stack consumes)."""
+
+    distribution_strategy: str = "AllreduceStrategy"
+    optimize_mode: str = OptimizeMode.SINGLE_JOB
+    enable_elastic_scheduling: bool = True
+    enable_dynamic_sharding: bool = True
+    replica_specs: Dict[str, ReplicaSpec] = dataclasses.field(
+        default_factory=dict)
+
+    def to_dict(self) -> Dict:
+        return {
+            "distributionStrategy": self.distribution_strategy,
+            "optimizeMode": self.optimize_mode,
+            "enableElasticScheduling": self.enable_elastic_scheduling,
+            "enableDynamicSharding": self.enable_dynamic_sharding,
+            "replicaSpecs": {k: v.to_dict()
+                             for k, v in self.replica_specs.items()},
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "ElasticJobSpec":
+        return cls(
+            distribution_strategy=d.get("distributionStrategy",
+                                        "AllreduceStrategy"),
+            optimize_mode=d.get("optimizeMode", OptimizeMode.SINGLE_JOB),
+            enable_elastic_scheduling=d.get("enableElasticScheduling",
+                                            True),
+            enable_dynamic_sharding=d.get("enableDynamicSharding", True),
+            replica_specs={k: ReplicaSpec.from_dict(v)
+                           for k, v in d.get("replicaSpecs", {}).items()})
+
+
+@dataclasses.dataclass
+class ElasticJob:
+    name: str
+    namespace: str = "default"
+    spec: ElasticJobSpec = dataclasses.field(default_factory=ElasticJobSpec)
+    phase: str = JobPhase.PENDING
+    master_addr: str = ""
+
+    @classmethod
+    def from_manifest(cls, obj: Dict) -> "ElasticJob":
+        meta = obj.get("metadata", {})
+        return cls(name=meta.get("name", ""),
+                   namespace=meta.get("namespace", "default"),
+                   spec=ElasticJobSpec.from_dict(obj.get("spec", {})),
+                   phase=obj.get("status", {}).get("phase",
+                                                   JobPhase.PENDING))
+
+
+@dataclasses.dataclass
+class ScalePlan:
+    """Parity scaleplan_controller.go — a requested replica change."""
+
+    job_name: str
+    replica_counts: Dict[str, int] = dataclasses.field(default_factory=dict)
+
+    @classmethod
+    def from_manifest(cls, obj: Dict) -> "ScalePlan":
+        spec = obj.get("spec", {})
+        return cls(job_name=spec.get("ownerJob", ""),
+                   replica_counts={
+                       k: v.get("replicas", 0)
+                       for k, v in spec.get("replicaResourceSpecs",
+                                            {}).items()})
+
+
+def elasticjob_crd_manifest() -> Dict:
+    """The CRD a cluster admin applies (kubectl apply -f)."""
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"elasticjobs.{GROUP}"},
+        "spec": {
+            "group": GROUP,
+            "names": {"kind": "ElasticJob", "plural": "elasticjobs",
+                      "singular": "elasticjob", "shortNames": ["ej"]},
+            "scope": "Namespaced",
+            "versions": [{
+                "name": VERSION, "served": True, "storage": True,
+                "schema": {"openAPIV3Schema": {
+                    "type": "object",
+                    "properties": {
+                        "spec": {"type": "object",
+                                 "x-kubernetes-preserve-unknown-fields":
+                                     True},
+                        "status": {"type": "object",
+                                   "x-kubernetes-preserve-unknown-fields":
+                                       True},
+                    }}},
+                "subresources": {"status": {}},
+            }],
+        },
+    }

@@ -366,3 +366,57 @@ class TestReviewInvariants:
         store.import_(np.array([1], np.int64), slots[:1])
         s_new, _ = store.lookup_or_insert(np.array([99], np.int64))
         assert s_new[0] != slots[0]
+
+
+class TestKvRemove:
+    @pytest.mark.parametrize("store", _stores(),
+                             ids=lambda s: type(s).__name__)
+    def test_remove_recycles(self, store):
+        slots, _ = store.lookup_or_insert(np.array([1, 2, 3], np.int64))
+        assert store.remove(np.array([2], np.int64)) == 1
+        assert store.lookup(np.array([2], np.int64))[0] == -1
+        # the freed slot is handed to the next insert
+        s_new, _ = store.lookup_or_insert(np.array([99], np.int64))
+        assert s_new[0] == slots[1]
+
+
+class TestHybridEmbedding:
+    def test_spill_and_promote_roundtrip(self, tmp_path):
+        from dlrover_wuqiong_tpu.embedding.hybrid import HybridKvEmbedding
+
+        emb = HybridKvEmbedding(dim=4, max_hot_rows=8,
+                                optimizer=SparseOptConfig(kind="sgd",
+                                                          lr=1.0),
+                                prefer_native=False)
+        # train distinctive rows for the first ids
+        ids_a = np.arange(1, 6, dtype=np.int64)
+        slots_a = emb.lookup_slots(ids_a)
+        grads = -np.eye(5, 4, dtype=np.float32)  # row i gets +e_i
+        before = np.asarray(emb.gather(slots_a)).copy()
+        emb.apply_gradients(slots_a, grads)
+
+        # flood with new ids: capacity 8 forces demotion, not growth
+        for step in range(6):
+            emb.lookup_slots(np.arange(100 + step * 5, 105 + step * 5,
+                                       dtype=np.int64))
+        assert emb.capacity == 8  # hot tier never grew
+        assert len(emb.overflow) > 0
+
+        # the trained rows promote back with values + opt state intact
+        slots_back = emb.lookup_slots(ids_a)
+        after = np.asarray(emb.gather(slots_back))
+        np.testing.assert_allclose(after, before + np.eye(5, 4), atol=1e-6)
+
+    def test_disk_spill(self, tmp_path):
+        from dlrover_wuqiong_tpu.embedding.hybrid import (
+            HybridKvEmbedding,
+            OverflowStore,
+        )
+
+        store = OverflowStore(3, ("m",), spill_dir=str(tmp_path))
+        store.put(42, np.ones(3, np.float32), {"m": np.full(3, 2.0)})
+        assert 42 in store
+        entry = store.pop(42)
+        np.testing.assert_array_equal(entry["value"], np.ones(3))
+        np.testing.assert_array_equal(entry["m"], np.full(3, 2.0))
+        assert 42 not in store

@@ -277,3 +277,71 @@ class TestMasterEndToEnd:
         sc0 = ShardingClient(c0, "d3", batch_size=5, dataset_size=50)
         t2 = sc0.fetch_shard()
         assert t2.shard.start == task.shard.start
+
+
+class TestNetTopology:
+    def test_subnet_grouping(self):
+        from dlrover_wuqiong_tpu.master.net_topology import (
+            DpTopologySorter,
+            NodeTopologyMeta,
+        )
+
+        metas = [
+            NodeTopologyMeta(0, 0, ip="10.0.1.5"),
+            NodeTopologyMeta(1, 1, ip="10.0.2.5"),
+            NodeTopologyMeta(2, 2, ip="10.0.1.6"),
+            NodeTopologyMeta(3, 3, ip="10.0.2.6"),
+        ]
+        out = DpTopologySorter().sort(metas)
+        # same-/24 nodes contiguous: [0,2] then [1,3]
+        assert [m.node_id for m in out] == [0, 2, 1, 3]
+
+    def test_slice_id_beats_subnet(self):
+        from dlrover_wuqiong_tpu.master.net_topology import (
+            DpTopologySorter,
+            NodeTopologyMeta,
+        )
+
+        metas = [
+            NodeTopologyMeta(0, 0, ip="10.0.1.5", slice_id="s0"),
+            NodeTopologyMeta(1, 1, ip="10.0.1.6", slice_id="s1"),
+            NodeTopologyMeta(2, 2, ip="10.0.2.5", slice_id="s0"),
+        ]
+        out = DpTopologySorter().sort(metas)
+        assert [m.node_id for m in out] == [0, 2, 1]
+
+    def test_stable_without_locality(self):
+        from dlrover_wuqiong_tpu.master.net_topology import (
+            DpTopologySorter,
+            NodeTopologyMeta,
+        )
+
+        metas = [NodeTopologyMeta(i, 3 - i) for i in range(4)]
+        out = DpTopologySorter().sort(metas)
+        assert [m.node_rank for m in out] == [0, 1, 2, 3]
+
+
+class TestParalConfigTuner:
+    def test_poll_writes_file_once_per_change(self, tmp_path):
+        from dlrover_wuqiong_tpu.agent.config_tuner import (
+            ParalConfigTuner,
+            read_paral_config,
+        )
+        from dlrover_wuqiong_tpu.common import messages as msg
+
+        class FakeMC:
+            def __init__(self):
+                self.cfg = msg.ParallelConfig(dataloader_batch_size=16)
+
+            def get_paral_config(self):
+                return self.cfg
+
+        mc = FakeMC()
+        path = str(tmp_path / "paral.json")
+        tuner = ParalConfigTuner(mc, config_path=path)
+        assert tuner.poll_once() is True
+        assert read_paral_config(path)["dataloader_batch_size"] == 16
+        assert tuner.poll_once() is False  # unchanged → no rewrite
+        mc.cfg = msg.ParallelConfig(dataloader_batch_size=32)
+        assert tuner.poll_once() is True
+        assert read_paral_config(path)["dataloader_batch_size"] == 32

@@ -1,0 +1,150 @@
+"""ElasticJob operator tests (reference go/operator parity).
+
+Mirrors `go/operator/pkg/controllers/suite_test.go` in spirit: reconcile an
+ElasticJob CR into a running master, drive phase transitions, apply a
+ScalePlan.
+"""
+
+import sys
+import time
+
+from dlrover_wuqiong_tpu.operator import (
+    ElasticJob,
+    ElasticJobController,
+    ElasticJobSpec,
+    InMemoryJobStore,
+    JobPhase,
+    ReplicaSpec,
+    ScalePlan,
+    elasticjob_crd_manifest,
+)
+from dlrover_wuqiong_tpu.scheduler import NodeSpec, SubprocessSchedulerClient
+
+
+class _FakeMaster:
+    def __init__(self, job):
+        self.addr = "127.0.0.1:1234"
+        self.exit_code = None
+        self.scaled = []
+
+    def poll(self):
+        return self.exit_code
+
+    def scale(self, counts):
+        self.scaled.append(counts)
+
+
+class TestController:
+    def _setup(self):
+        store = InMemoryJobStore()
+        masters = {}
+
+        def factory(job):
+            masters[job.name] = _FakeMaster(job)
+            return masters[job.name]
+
+        ctl = ElasticJobController(store, master_factory=factory)
+        return store, ctl, masters
+
+    def test_reconcile_creates_master_once(self):
+        store, ctl, masters = self._setup()
+        job = ElasticJob("j1", spec=ElasticJobSpec(
+            replica_specs={"worker": ReplicaSpec(replicas=3)}))
+        store.submit(job)
+        ctl.reconcile_once()
+        assert "j1" in masters
+        assert store.list_jobs()[0].phase == JobPhase.LAUNCHING
+        ctl.reconcile_once()  # idempotent: still one master, now RUNNING
+        assert len(masters) == 1
+        assert store.list_jobs()[0].phase == JobPhase.RUNNING
+
+    def test_phase_follows_master_exit(self):
+        store, ctl, masters = self._setup()
+        store.submit(ElasticJob("j2"))
+        ctl.reconcile_once()
+        ctl.reconcile_once()
+        masters["j2"].exit_code = 0
+        ctl.reconcile_once()
+        assert store.list_jobs()[0].phase == JobPhase.SUCCEEDED
+
+    def test_failed_master(self):
+        store, ctl, masters = self._setup()
+        store.submit(ElasticJob("j3"))
+        ctl.reconcile_once()
+        masters["j3"].exit_code = 2
+        ctl.reconcile_once()
+        assert store.list_jobs()[0].phase == JobPhase.FAILED
+
+    def test_scale_plan_forwarded(self):
+        store, ctl, masters = self._setup()
+        store.submit(ElasticJob("j4"))
+        ctl.reconcile_once()
+        store.submit_scale_plan(ScalePlan("j4", {"worker": 5}))
+        ctl.reconcile_once()
+        assert masters["j4"].scaled == [{"worker": 5}]
+
+
+class TestManifests:
+    def test_crd_manifest_shape(self):
+        m = elasticjob_crd_manifest()
+        assert m["kind"] == "CustomResourceDefinition"
+        assert m["spec"]["names"]["kind"] == "ElasticJob"
+
+    def test_job_from_manifest(self):
+        obj = {
+            "metadata": {"name": "trainer", "namespace": "ml"},
+            "spec": {
+                "distributionStrategy": "AllreduceStrategy",
+                "replicaSpecs": {"worker": {"replicas": 4,
+                                            "memory_mb": 2048}},
+            },
+        }
+        job = ElasticJob.from_manifest(obj)
+        assert job.name == "trainer" and job.namespace == "ml"
+        assert job.spec.replica_specs["worker"].replicas == 4
+
+
+class TestRealMasterProcess:
+    def test_subprocess_master_lifecycle(self):
+        """The default factory launches a real master process through the
+        scheduler client and tracks it to completion."""
+        client = SubprocessSchedulerClient()
+        store = InMemoryJobStore()
+        ctl = ElasticJobController(store, scheduler_client=client)
+        # a short-lived stand-in master (runs 1s then exits 0)
+        def factory(job):
+            spec = NodeSpec(node_type="master", node_id=0,
+                            command=[sys.executable, "-c",
+                                     "import time; time.sleep(1)"])
+            assert client.create_node(spec)
+            return _handle(client)
+
+        class _handle:
+            def __init__(self, client):
+                self.client = client
+                self.addr = ""
+
+            def poll(self):
+                nodes = self.client.list_nodes()
+                if not nodes:
+                    return 0
+                from dlrover_wuqiong_tpu.common.constants import NodeStatus
+                st = nodes[0].status
+                return {NodeStatus.SUCCEEDED: 0,
+                        NodeStatus.FAILED: 1}.get(st)
+
+            def scale(self, counts):
+                pass
+
+        ctl.master_factory = factory
+        store.submit(ElasticJob("real1"))
+        ctl.reconcile_once()
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            ctl.reconcile_once()
+            if store.list_jobs()[0].phase in (JobPhase.SUCCEEDED,
+                                              JobPhase.FAILED):
+                break
+            time.sleep(0.3)
+        assert store.list_jobs()[0].phase == JobPhase.SUCCEEDED
+        client.close()
