@@ -1,0 +1,20 @@
+"""dlrover_wuqiong_tpu — TPU-native elastic training framework.
+
+Public API map (heavy imports stay lazy — import the submodule you need):
+
+  auto.accelerate.auto_accelerate   one-call strategy → compiled sharded step
+  auto.engine.search_strategy       candidate mesh plans scored on real compiles
+  trainer.trainer.Trainer           HF-style training loop over the whole stack
+  trainer.elastic.init_elastic      join the agent-managed jax.distributed world
+  checkpoint.checkpointer.FlashCheckpointer   sub-second blocking saves
+  embedding.KvEmbedding             dynamic-vocabulary sparse embeddings
+  parallel.*                        mesh planning, sharding rules, ring/ulysses
+                                    attention, pipeline, local SGD (DiLoCo)
+  ops.*                             pallas flash attention, int8/fp8 quant
+  rl.PPOTrainer                     RLHF engine (KV-cache generate + PPO)
+  run                               `python -m dlrover_wuqiong_tpu.run` launcher
+
+See README.md for the reference (DLRover/ATorch/TFPlus) parity map.
+"""
+
+__version__ = "0.2.0"

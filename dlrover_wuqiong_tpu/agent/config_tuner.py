@@ -53,7 +53,8 @@ class ParalConfigTuner:
         payload = json.dumps(dataclasses.asdict(cfg), sort_keys=True)
         if payload == self._last_written:
             return False
-        os.makedirs(os.path.dirname(self.config_path), exist_ok=True)
+        os.makedirs(os.path.dirname(self.config_path) or ".",
+                    exist_ok=True)
         tmp = f"{self.config_path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             f.write(payload)

@@ -1,0 +1,19 @@
+"""Brain: cluster-level resource optimization service + client.
+
+Parity axis: reference `dlrover/go/brain/` (15.2k LoC Go service with a
+MySQL datastore and optimizer plugins; gRPC API `persist_metrics`,
+`optimize`, `get_job_metrics` — `dlrover/proto/brain.proto:196-199`) and
+`dlrover/python/master/resource/brain_optimizer.py:124`
+(`BrainResoureOptimizer`, the master-side client).
+
+Python/TPU redesign: the service reuses the framework's typed JSON-RPC and
+the same phased optimization logic the local optimizer uses
+(`master/resource_optimizer.py`) — cluster mode means many masters share
+one Brain, so its datastore aggregates usage ACROSS jobs and new jobs
+start from the fleet prior instead of cold defaults.
+"""
+
+from .client import BrainClient, BrainResourceOptimizer
+from .service import BrainService
+
+__all__ = ["BrainClient", "BrainResourceOptimizer", "BrainService"]

@@ -1,0 +1,73 @@
+"""Brain client + the master-side Brain-backed resource optimizer.
+
+Parity: reference `dlrover/python/brain/client.py` (gRPC stub) and
+`master/resource/brain_optimizer.py:124` (`BrainResoureOptimizer` — the
+optimizer implementation the master uses in `cluster` optimize mode).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..common import messages as msg
+from ..common.comm import RpcClient
+from ..common.log import get_logger
+from ..common.node import NodeResource
+from ..master.resource_optimizer import LocalResourceOptimizer
+
+logger = get_logger("brain_client")
+
+
+class BrainClient:
+    def __init__(self, addr: str, job_name: str):
+        self._client = RpcClient(addr, node_id=-1, node_type="master")
+        self.job_name = job_name
+
+    def persist_metrics(self, node_type: str, cpu: float, memory_mb: float):
+        return self._client.report(msg.BrainPersistMetrics(
+            job_name=self.job_name, node_type=node_type, cpu=cpu,
+            memory_mb=memory_mb))
+
+    def optimize(self, node_type: str) -> msg.BrainOptimizeResponse:
+        return self._client.get(msg.BrainOptimizeRequest(
+            job_name=self.job_name, node_type=node_type))
+
+    def get_job_metrics(self, node_type: str) -> str:
+        resp = self._client.get(msg.BrainJobMetricsRequest(
+            job_name=self.job_name, node_type=node_type))
+        return resp.samples
+
+    def close(self):
+        self._client.close()
+
+
+class BrainResourceOptimizer(LocalResourceOptimizer):
+    """Drop-in for LocalResourceOptimizer that consults the Brain.
+
+    Usage reports go BOTH local and to the Brain; plans prefer the Brain's
+    (fleet-informed) answer and fall back to the local phased plan when
+    the service is unreachable — a Brain outage must never stall a job
+    (reference optimizer degrades the same way).
+    """
+
+    def __init__(self, brain_addr: str, job_name: str, **kw):
+        super().__init__(**kw)
+        self.client = BrainClient(brain_addr, job_name)
+
+    def report_usage(self, node_type: str, usage: NodeResource):
+        super().report_usage(node_type, usage)
+        try:
+            self.client.persist_metrics(node_type, usage.cpu,
+                                        usage.memory_mb)
+        except Exception:  # noqa: BLE001 — brain is advisory
+            logger.debug("brain persist failed", exc_info=True)
+
+    def plan_node_resource(self, node_type: str = "worker") -> NodeResource:
+        try:
+            resp = self.client.optimize(node_type)
+            if resp.memory_mb > 0:
+                return NodeResource(cpu=resp.cpu, memory_mb=resp.memory_mb)
+        except Exception:  # noqa: BLE001
+            logger.debug("brain optimize failed — using local plan",
+                         exc_info=True)
+        return super().plan_node_resource(node_type)

@@ -283,6 +283,47 @@ class ParallelConfigRequest:
     node_id: int = -1
 
 
+# ---------------------------------------------------------------- brain
+
+
+@message
+class BrainPersistMetrics:
+    """Parity: brain.proto persist_metrics."""
+
+    job_name: str = ""
+    node_type: str = "worker"
+    cpu: float = 0.0
+    memory_mb: float = 0.0
+
+
+@message
+class BrainOptimizeRequest:
+    """Parity: brain.proto optimize."""
+
+    job_name: str = ""
+    node_type: str = "worker"
+
+
+@message
+class BrainOptimizeResponse:
+    cpu: float = 0.0
+    memory_mb: float = 0.0
+    stage: str = ""
+
+
+@message
+class BrainJobMetricsRequest:
+    """Parity: brain.proto get_job_metrics."""
+
+    job_name: str = ""
+    node_type: str = "worker"
+
+
+@message
+class BrainJobMetricsResponse:
+    samples: str = ""  # JSON list of usage samples
+
+
 # ---------------------------------------------------------------- diagnosis
 
 

@@ -158,24 +158,46 @@ class HybridKvEmbedding(KvEmbedding):
 
     def lookup_slots(self, ids: np.ndarray, insert: bool = True,
                      train: bool = True) -> np.ndarray:
-        """Promote spilled ids back into the hot tier before lookup."""
+        """Promote spilled ids back into the hot tier before lookup.
+
+        Promotion only happens on insert lookups (a read-only GatherOrZeros
+        pass must not mutate either tier); it runs with train=False (a
+        restore, not a frequency-gated admission), writes all rows with ONE
+        batched scatter per tensor, and pops overflow entries only AFTER
+        their rows landed — a failed/masked promotion never loses data.
+        """
         import jax.numpy as jnp
 
         self._tick += 1
         ids = np.ascontiguousarray(ids, np.int64)
-        spilled = [int(i) for i in np.unique(ids)
-                   if i in self.overflow]
-        for key in spilled:
-            entry = self.overflow.pop(key)
-            slot = int(self._base_lookup(np.array([key], np.int64))[0])
-            if slot == _NULL_SLOT:
-                continue
-            self.values = self.values.at[slot].set(
-                jnp.asarray(entry["value"], self.values.dtype))
-            for k in self.slot_state:
-                if k in entry:
-                    self.slot_state[k] = self.slot_state[k].at[slot].set(
-                        jnp.asarray(entry[k], self.slot_state[k].dtype))
+        spilled = [int(i) for i in np.unique(ids) if i in self.overflow]
+        if spilled and insert:
+            keys = np.array(spilled, np.int64)
+            slots = self._base_lookup(keys, insert=True, train=False)
+            entries, idx, promoted = [], [], []
+            for key, slot in zip(spilled, slots.tolist()):
+                if slot == _NULL_SLOT:
+                    continue
+                entry = self.overflow.get(key)
+                if entry is None:
+                    continue
+                entries.append(entry)
+                idx.append(slot)
+                promoted.append(key)
+            if entries:
+                idx_arr = np.array(idx)
+                vals = np.stack([e["value"] for e in entries])
+                self.values = self.values.at[idx_arr].set(
+                    jnp.asarray(vals, self.values.dtype))
+                for k, table in self.slot_state.items():
+                    rows = np.stack([
+                        np.asarray(e.get(k, np.zeros(table.shape[1:],
+                                                     np.float32)))
+                        for e in entries])
+                    self.slot_state[k] = table.at[idx_arr].set(
+                        jnp.asarray(rows, table.dtype))
+                for key in promoted:
+                    self.overflow.pop(key)
         return self._base_lookup(ids, insert=insert, train=train)
 
     def _base_lookup(self, ids, insert: bool = True, train: bool = True):
@@ -228,13 +250,33 @@ class HybridKvEmbedding(KvEmbedding):
     def export_delta(self):
         """Store delta + ALL overflow rows (a demoted row's dirty bit died
         with its mapping; including the cold tier keeps deltas lossless at
-        the cost of their size)."""
+        the cost of their size).  The cold rows are read straight from the
+        host-resident overflow — no device-table gather."""
         blob, epoch = super().export_delta()
-        full = self.export_full()
-        cold = full["slots"] == -1
-        if cold.any():
-            for k in blob:
-                blob[k] = np.concatenate([blob[k], full[k][cold]])
+        extra_keys, extra_vals = [], []
+        extra_state = {k: [] for k in self.slot_state}
+        for key in list(self.overflow._rows):  # noqa: SLF001 same package
+            entry = self.overflow.get(key)
+            if entry is None:
+                continue
+            extra_keys.append(key)
+            extra_vals.append(entry["value"])
+            for k in extra_state:
+                extra_state[k].append(entry.get(
+                    k, np.zeros_like(entry["value"])))
+        if extra_keys:
+            blob["keys"] = np.concatenate(
+                [blob["keys"], np.array(extra_keys, np.int64)])
+            blob["slots"] = np.concatenate(
+                [blob["slots"], np.full(len(extra_keys), -1, np.int64)])
+            blob["values"] = np.concatenate(
+                [blob["values"], np.stack(extra_vals)]) \
+                if len(blob["values"]) else np.stack(extra_vals)
+            for k in extra_state:
+                prev = blob[f"opt_{k}"]
+                rows = np.stack(extra_state[k])
+                blob[f"opt_{k}"] = np.concatenate([prev, rows]) \
+                    if len(prev) else rows
         return blob, epoch
 
     def import_full(self, blob):

@@ -154,8 +154,13 @@ class ElasticJobController:
         worker_spec = job.spec.replica_specs.get("worker")
         replicas = worker_spec.replicas if worker_spec else 1
         # one client may serve several jobs: the node id identifies WHOSE
-        # master this is (stable hash of the job name)
-        node_id = abs(hash(job.name)) % (1 << 31)
+        # master this is.  hashlib, not hash(): a restarted controller must
+        # compute the SAME id to re-associate the still-running master pod
+        # (str hashes are salted per process).
+        import hashlib
+
+        node_id = int.from_bytes(
+            hashlib.md5(job.name.encode()).digest()[:4], "big") % (1 << 31)
         spec = NodeSpec(
             node_type=self.MASTER_TYPE, node_id=node_id,
             command=["python", "-c",

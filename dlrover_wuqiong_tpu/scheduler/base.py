@@ -66,5 +66,9 @@ def new_scheduler_client(platform: str, **kwargs) -> SchedulerClient:
         from .k8s import K8sSchedulerClient
 
         return K8sSchedulerClient(**kwargs)
+    if platform == "ray":
+        from .ray_scheduler import RaySchedulerClient
+
+        return RaySchedulerClient(**kwargs)
     raise ValueError(f"unknown platform {platform!r} "
-                     "(expected fake|local|k8s)")
+                     "(expected fake|local|k8s|ray)")

@@ -1,0 +1,161 @@
+"""Ray scheduler backend: a "pod" is a Ray actor.
+
+Parity: reference `dlrover/python/scheduler/ray.py` (RayClient actor
+management), `master/scaler/ray_scaler.py` (`ActorScaler`) and
+`master/watcher/ray_watcher.py` (`ActorWatcher`) — collapsed into the same
+SchedulerClient interface the other backends implement, so the master's
+PodScaler/PodWatcher drive Ray unchanged.
+
+The `ray` package is imported lazily (mirrors the k8s backend); hosts
+without it get a clear error at construction.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ..common.constants import NodeEventType, NodeStatus
+from ..common.log import get_logger
+from ..common.node import Node, NodeEvent
+from .base import NodeSpec, SchedulerClient
+
+logger = get_logger("ray_scheduler")
+
+
+class RaySchedulerClient(SchedulerClient):
+    def __init__(self, job_name: str = "dwt", namespace: str = "dwt",
+                 init_kwargs: Optional[Dict] = None):
+        try:
+            import ray  # type: ignore
+        except ImportError as e:  # pragma: no cover - env without ray
+            raise RuntimeError(
+                "RaySchedulerClient needs the `ray` package; use "
+                "platform='local' on hosts without it") from e
+        self._ray = ray
+        if not ray.is_initialized():
+            ray.init(namespace=namespace, **(init_kwargs or {}))
+        self.job_name = job_name
+        self._actors: Dict[Tuple[str, int], object] = {}
+        self._tasks: Dict[Tuple[str, int], object] = {}  # run() futures
+        self._nodes: Dict[Tuple[str, int], Node] = {}
+        self._lock = threading.Lock()
+        self._events: "queue.Queue[NodeEvent]" = queue.Queue()
+
+    def _actor_name(self, node_type: str, node_id: int) -> str:
+        return f"{self.job_name}-{node_type}-{node_id}"
+
+    def create_node(self, spec: NodeSpec) -> bool:
+        if not spec.command:
+            raise ValueError("ray backend needs spec.command")
+        ray = self._ray
+
+        @ray.remote
+        class _NodeActor:  # runs the command as a subprocess inside the actor
+            def run(self, command, env):
+                import os
+                import subprocess
+
+                e = dict(os.environ)
+                e.update(env)
+                return subprocess.run(command, env=e).returncode
+
+        opts = {"name": self._actor_name(spec.node_type, spec.node_id),
+                "lifetime": "detached"}
+        if spec.resource.cpu:
+            opts["num_cpus"] = spec.resource.cpu
+        if spec.resource.memory_mb:
+            opts["memory"] = int(spec.resource.memory_mb * 1024 * 1024)
+        try:
+            actor = _NodeActor.options(**opts).remote()
+            task = actor.run.remote(spec.command, spec.env)
+        except Exception:  # noqa: BLE001
+            logger.exception("ray actor create failed: %s",
+                             self._actor_name(spec.node_type, spec.node_id))
+            return False
+        node = Node(spec.node_type, spec.node_id,
+                    rank_index=spec.rank_index,
+                    config_resource=spec.resource)
+        node.status = NodeStatus.RUNNING
+        node.create_time = time.time()
+        with self._lock:
+            self._actors[(spec.node_type, spec.node_id)] = actor
+            self._tasks[(spec.node_type, spec.node_id)] = task
+            self._nodes[(spec.node_type, spec.node_id)] = node
+        self._events.put(NodeEvent(NodeEventType.ADDED, node))
+        return True
+
+    def delete_node(self, node_type: str, node_id: int) -> bool:
+        with self._lock:
+            actor = self._actors.pop((node_type, node_id), None)
+            self._tasks.pop((node_type, node_id), None)
+            node = self._nodes.pop((node_type, node_id), None)
+        if actor is None:
+            return False
+        try:
+            self._ray.kill(actor)
+        except Exception:  # noqa: BLE001
+            pass
+        if node is not None:
+            node.status = NodeStatus.DELETED
+            self._events.put(NodeEvent(NodeEventType.DELETED, node))
+        return True
+
+    def list_nodes(self) -> List[Node]:
+        self._poll()
+        with self._lock:
+            return list(self._nodes.values())
+
+    def watch(self, timeout: float = 1.0) -> Iterator[NodeEvent]:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            got = False
+            try:
+                while True:
+                    yield self._events.get_nowait()
+                    got = True
+            except queue.Empty:
+                pass
+            events = self._poll()
+            for e in events:
+                yield e
+            if events or got:
+                deadline = time.time() + timeout
+            else:
+                time.sleep(0.05)
+
+    def _poll(self) -> List[NodeEvent]:
+        """Check actor run() futures for completion (parity ActorWatcher)."""
+        ray = self._ray
+        events = []
+        with self._lock:
+            items = list(self._tasks.items())
+        for key, task in items:
+            done, _ = ray.wait([task], timeout=0)
+            if not done:
+                continue
+            with self._lock:
+                node = self._nodes.get(key)
+                self._tasks.pop(key, None)
+            if node is None or node.status in (NodeStatus.SUCCEEDED,
+                                               NodeStatus.FAILED):
+                continue
+            try:
+                code = ray.get(done[0])
+            except Exception:  # noqa: BLE001 — actor died
+                code = 1
+                node.exit_reason = "actor_died"
+            node.status = (NodeStatus.SUCCEEDED if code == 0
+                           else NodeStatus.FAILED)
+            if code != 0 and not node.exit_reason:
+                node.exit_reason = f"exit_code={code}"
+            events.append(NodeEvent(NodeEventType.MODIFIED, node))
+        return events
+
+    def close(self):
+        with self._lock:
+            keys = list(self._actors)
+        for node_type, node_id in keys:
+            self.delete_node(node_type, node_id)

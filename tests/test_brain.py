@@ -1,0 +1,91 @@
+"""Brain service/client tests (reference go/brain parity).
+
+Mirrors the brain's gotest coverage in spirit: persist → optimize flows,
+fleet prior for cold jobs, degradation when the service is down.
+"""
+
+import pytest
+
+from dlrover_wuqiong_tpu.brain import (
+    BrainClient,
+    BrainResourceOptimizer,
+    BrainService,
+)
+from dlrover_wuqiong_tpu.common.node import NodeResource
+
+
+_OPT_KW = dict(default_resource=NodeResource(cpu=2, memory_mb=500),
+               sample_after=2, stable_after=4, headroom=2.0)
+
+
+@pytest.fixture()
+def brain():
+    svc = BrainService(**_OPT_KW)
+    svc.start()
+    yield svc
+    svc.stop()
+
+
+class TestBrain:
+    def test_persist_then_optimize(self, brain):
+        c = BrainClient(brain.addr, "job1")
+        c.persist_metrics("worker", cpu=1.0, memory_mb=800)
+        c.persist_metrics("worker", cpu=1.0, memory_mb=900)
+        resp = c.optimize("worker")
+        assert resp.stage == "sample"
+        assert resp.memory_mb == 1800  # max * headroom
+        c.close()
+
+    def test_cold_job_inherits_fleet_prior(self, brain):
+        c1 = BrainClient(brain.addr, "jobA")
+        for _ in range(3):
+            c1.persist_metrics("worker", cpu=2.0, memory_mb=1000)
+        # a brand-new job gets the fleet's plan, not defaults
+        c2 = BrainClient(brain.addr, "jobB")
+        resp = c2.optimize("worker")
+        assert resp.stage in ("sample", "stable")
+        assert resp.memory_mb == 2000
+        c1.close()
+        c2.close()
+
+    def test_get_job_metrics(self, brain):
+        c = BrainClient(brain.addr, "jobM")
+        c.persist_metrics("worker", cpu=1.5, memory_mb=512)
+        samples = c.get_job_metrics("worker")
+        assert "512" in samples
+        c.close()
+
+    def test_snapshot_roundtrip(self, tmp_path):
+        path = str(tmp_path / "brain.json")
+        svc = BrainService(snapshot_path=path, **_OPT_KW)
+        svc.start()
+        c = BrainClient(svc.addr, "jobS")
+        for _ in range(3):
+            c.persist_metrics("worker", cpu=1.0, memory_mb=700)
+        c.close()
+        svc.stop()
+        # a restarted brain remembers the fleet
+        svc2 = BrainService(snapshot_path=path, **_OPT_KW)
+        svc2.start()
+        c2 = BrainClient(svc2.addr, "another-job")
+        resp = c2.optimize("worker")
+        assert resp.stage != "init"
+        c2.close()
+        svc2.stop()
+
+
+class TestBrainResourceOptimizer:
+    def test_prefers_brain_plan(self, brain):
+        opt = BrainResourceOptimizer(brain.addr, "jobO", **_OPT_KW)
+        opt.report_usage("worker", NodeResource(cpu=1, memory_mb=600))
+        opt.report_usage("worker", NodeResource(cpu=1, memory_mb=650))
+        plan = opt.plan_node_resource("worker")
+        assert plan.memory_mb == 1300  # brain's answer (same math here)
+
+    def test_degrades_to_local_when_brain_down(self):
+        opt = BrainResourceOptimizer("127.0.0.1:1", "jobX", **_OPT_KW)
+        # reports fail silently; local samples still accumulate
+        opt.report_usage("worker", NodeResource(cpu=1, memory_mb=500))
+        opt.report_usage("worker", NodeResource(cpu=1, memory_mb=600))
+        plan = opt.plan_node_resource("worker")
+        assert plan.memory_mb == 1200  # local phased plan

@@ -420,3 +420,49 @@ class TestHybridEmbedding:
         np.testing.assert_array_equal(entry["value"], np.ones(3))
         np.testing.assert_array_equal(entry["m"], np.full(3, 2.0))
         assert 42 not in store
+
+
+class TestHybridPromotionSemantics:
+    def test_min_freq_promotion_never_loses_rows(self):
+        """A demoted row re-seen under min_freq gating must survive even
+        when the training lookup masks it to the null slot."""
+        from dlrover_wuqiong_tpu.embedding.hybrid import HybridKvEmbedding
+
+        emb = HybridKvEmbedding(dim=2, max_hot_rows=6, min_freq=2,
+                                optimizer=SparseOptConfig(kind="sgd",
+                                                          lr=1.0),
+                                prefer_native=False)
+        ids = np.array([5], np.int64)
+        emb.lookup_slots(ids)           # freq 1 → masked
+        slots = emb.lookup_slots(ids)   # freq 2 → admitted
+        assert slots[0] != 0
+        emb.apply_gradients(slots, np.full((1, 2), -3.0, np.float32))
+        trained = np.asarray(emb.gather(slots)).copy()
+        # flood to demote id 5
+        for s in range(8):
+            emb.lookup_slots(np.arange(100 + s * 4, 104 + s * 4,
+                                       dtype=np.int64))
+        # re-sight: promotion restores the trained row (freq restarts, so
+        # the first sighting may mask — data must still be intact)
+        emb.lookup_slots(ids)
+        s2 = emb.lookup_slots(ids)
+        got = np.asarray(emb.gather(s2))
+        np.testing.assert_allclose(got, trained, atol=1e-6)
+
+    def test_readonly_lookup_does_not_mutate(self):
+        from dlrover_wuqiong_tpu.embedding.hybrid import HybridKvEmbedding
+
+        emb = HybridKvEmbedding(dim=2, max_hot_rows=4, prefer_native=False)
+        emb.lookup_slots(np.array([1], np.int64))
+        for s in range(4):
+            emb.lookup_slots(np.arange(50 + s * 3, 53 + s * 3,
+                                       dtype=np.int64))
+        held = len(emb.overflow)
+        assert held > 0
+        vocab = emb.vocab_size
+        slots = emb.lookup_slots(np.array([1, 999], np.int64),
+                                 insert=False)
+        # spilled + unknown ids read the null row; nothing inserted or
+        # promoted
+        assert len(emb.overflow) == held
+        assert emb.vocab_size == vocab

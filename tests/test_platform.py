@@ -238,3 +238,14 @@ class TestDistJobManagerSubprocess:
         assert any(n.relaunch_count > 0 for n in jm.all_nodes())
         jm.stop()
         client.close()
+
+
+class TestRayBackend:
+    def test_factory_raises_without_ray(self):
+        try:
+            import ray  # noqa: F401
+            pytest.skip("ray installed — guarded-import test not applicable")
+        except ImportError:
+            pass
+        with pytest.raises(RuntimeError, match="ray"):
+            new_scheduler_client("ray")
