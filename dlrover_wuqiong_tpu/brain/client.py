@@ -65,7 +65,11 @@ class BrainResourceOptimizer(LocalResourceOptimizer):
     def plan_node_resource(self, node_type: str = "worker") -> NodeResource:
         try:
             resp = self.client.optimize(node_type)
-            if resp.memory_mb > 0:
+            # a cold/restarted Brain answers stage="init" with defaults —
+            # local observations (if any) beat a fleet that knows nothing
+            better_local = (resp.stage == "init"
+                            and self.stage(node_type) != "init")
+            if resp.memory_mb > 0 and not better_local:
                 return NodeResource(cpu=resp.cpu, memory_mb=resp.memory_mb)
         except Exception:  # noqa: BLE001
             logger.debug("brain optimize failed — using local plan",

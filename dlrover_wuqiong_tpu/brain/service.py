@@ -52,8 +52,9 @@ class BrainService:
         logger.info("brain service on :%d", self.port)
 
     def stop(self):
-        self._save_snapshot()
+        # server first: no handler may mutate optimizers mid-snapshot
         self._server.stop()
+        self._save_snapshot()
 
     # ------------------------------------------------------------- handlers
 
@@ -105,20 +106,22 @@ class BrainService:
         if not self._snapshot_path:
             return
         try:
+            data = {}
             with self._lock:
-                data = {
-                    job: {
+                jobs = list(self._per_job.items())
+            for job, opt in jobs:
+                with opt._lock:  # noqa: SLF001 — same package family
+                    data[job] = {
                         nt: [{"cpu": s.cpu, "memory_mb": s.memory_mb}
-                             for s in opt._usage_samples.get(nt, [])]
-                        for nt in opt._usage_samples  # noqa: SLF001
+                             for s in samples]
+                        for nt, samples in
+                        opt._usage_samples.items()  # noqa: SLF001
                     }
-                    for job, opt in self._per_job.items()
-                }
             tmp = self._snapshot_path + ".tmp"
             with open(tmp, "w") as f:
                 json.dump(data, f)
             os.replace(tmp, self._snapshot_path)
-        except OSError:
+        except (OSError, RuntimeError):
             logger.exception("brain snapshot failed")
 
     def _load_snapshot(self):

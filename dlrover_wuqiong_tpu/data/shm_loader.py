@@ -1,0 +1,192 @@
+"""Shared-memory coworker data loader: preprocessing in sidecar processes.
+
+Parity: reference `atorch/atorch/data/shm_context.py:139` (`ShmDataContext`)
+and `shm_dataloader.py:138` (`ShmDataloader`) — CPU-heavy preprocessing runs
+in coworker processes that hand finished batches to the trainer through
+shared memory, so the training process never blocks on tokenization/
+augmentation and no per-batch pickling crosses process boundaries.
+
+Design on this repo's IPC primitives (`common/multi_process.py`): a ring of
+POSIX-shm slots, each holding one fixed-shape batch (header + raw arrays,
+the `shm_handler` layout); producers claim free slot ids from one shared
+queue, write, and announce on a ready queue; the consumer yields zero-copy
+numpy views and recycles the slot when the next batch is requested.
+"""
+
+from __future__ import annotations
+
+import json
+import multiprocessing
+import os
+import time
+from typing import Any, Callable, Dict, Iterator, Optional
+
+import numpy as np
+
+from ..common.log import get_logger
+from ..common.multi_process import SharedMemoryBuffer, SharedQueue
+
+logger = get_logger("shm_loader")
+
+_HEADER = 1 << 16  # per-slot JSON header region
+
+
+def _flatten_example(batch: Dict[str, np.ndarray]):
+    metas, offset = [], _HEADER
+    for name in sorted(batch):
+        arr = np.ascontiguousarray(batch[name])
+        metas.append({"name": name, "dtype": arr.dtype.name,
+                      "shape": list(arr.shape), "offset": offset,
+                      "nbytes": arr.nbytes})
+        offset += arr.nbytes
+    return metas, offset
+
+
+def _write_slot(buf: SharedMemoryBuffer, batch: Dict[str, np.ndarray],
+                seq: int):
+    metas, _ = _flatten_example(batch)
+    header = json.dumps({"seq": seq, "metas": metas}).encode()
+    mv = buf.buf
+    mv[0:8] = len(header).to_bytes(8, "big")
+    mv[8:8 + len(header)] = header
+    for m in metas:
+        arr = np.ascontiguousarray(batch[m["name"]])
+        mv[m["offset"]:m["offset"] + m["nbytes"]] = \
+            arr.view(np.uint8).reshape(-1)
+
+
+def _read_slot(buf: SharedMemoryBuffer) -> Dict[str, np.ndarray]:
+    mv = buf.buf
+    n = int.from_bytes(bytes(mv[0:8]), "big")
+    header = json.loads(bytes(mv[8:8 + n]).decode())
+    out = {}
+    for m in header["metas"]:
+        raw = np.frombuffer(bytes(mv[m["offset"]:m["offset"] + m["nbytes"]]),
+                            dtype=np.dtype(m["dtype"]))
+        out[m["name"]] = raw.reshape(m["shape"])
+    return out
+
+
+def _producer_main(job_name: str, worker_id: int, num_workers: int,
+                   produce_fn: Callable[[int, int], Dict[str, np.ndarray]],
+                   max_steps: int):
+    """Coworker loop: claim slot → produce → write → announce."""
+    free_q = SharedQueue(f"{job_name}-shm-free", master=False)
+    ready_q = SharedQueue(f"{job_name}-shm-ready", master=False)
+    step = worker_id
+    try:
+        while max_steps < 0 or step < max_steps:
+            slot = free_q.get()
+            if slot is None or (isinstance(slot, int) and slot < 0):
+                break  # shutdown token
+            try:
+                batch = produce_fn(worker_id, step)
+                buf = SharedMemoryBuffer(f"{job_name}_shm_slot_{slot}")
+                _write_slot(buf, batch, step)
+                buf.close()
+            except Exception as e:  # noqa: BLE001 — surface to consumer
+                # a dead-silent producer would make training "complete"
+                # early as if the data ran out
+                ready_q.put({"error": f"worker {worker_id} step {step}: "
+                                      f"{e!r}"})
+                raise
+            ready_q.put(slot)
+            step += num_workers
+    except (EOFError, OSError, ConnectionError):
+        pass  # consumer went away
+
+
+class ShmCoworkerLoader:
+    """Iterate batches produced by coworker processes through shm.
+
+    produce_fn(worker_id, step) -> {name: np.ndarray} with shapes/dtypes
+    matching `example_batch` (slots are sized once from it).  Batches are
+    yielded in READY order, not step order (parity: the reference's
+    unordered dataloader) — pass num_workers=1 for strict ordering.
+    """
+
+    def __init__(self, produce_fn: Callable,
+                 example_batch: Dict[str, np.ndarray],
+                 num_workers: int = 2, depth: int = 4,
+                 job_name: Optional[str] = None, max_steps: int = -1):
+        self.job_name = job_name or f"dwt-shmdl-{os.getpid()}"
+        _, slot_size = _flatten_example(example_batch)
+        self._slots = [
+            SharedMemoryBuffer(f"{self.job_name}_shm_slot_{i}", create=True,
+                               size=slot_size)
+            for i in range(depth)
+        ]
+        self._free_q = SharedQueue(f"{self.job_name}-shm-free", master=True)
+        self._ready_q = SharedQueue(f"{self.job_name}-shm-ready",
+                                    master=True)
+        for i in range(depth):
+            self._free_q.put(i)
+        self._inflight_slot: Optional[int] = None
+        self._procs = [
+            multiprocessing.Process(
+                target=_producer_main,
+                args=(self.job_name, w, num_workers, produce_fn, max_steps),
+                daemon=True)
+            for w in range(num_workers)
+        ]
+        for p in self._procs:
+            p.start()
+        self._num_workers = num_workers
+        self._max_steps = max_steps
+        self._yielded = 0
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        return self
+
+    def __next__(self) -> Dict[str, np.ndarray]:
+        self._recycle()
+        if self._max_steps >= 0 and self._yielded >= self._max_steps:
+            raise StopIteration
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            if self._max_steps >= 0 and self._yielded >= self._max_steps:
+                raise StopIteration
+            try:
+                slot = self._ready_q.get(timeout=1.0)
+            except Exception:  # noqa: BLE001 — queue.Empty
+                if not any(p.is_alive() for p in self._procs):
+                    bad = [p.exitcode for p in self._procs
+                           if p.exitcode not in (0, None)]
+                    if bad:
+                        raise RuntimeError(
+                            f"coworker producers crashed (exit codes "
+                            f"{bad})") from None
+                    raise StopIteration from None
+                continue
+            if isinstance(slot, dict) and "error" in slot:
+                raise RuntimeError(f"coworker produce failed: "
+                                   f"{slot['error']}")
+            self._inflight_slot = slot
+            self._yielded += 1
+            return _read_slot(self._slots[slot])
+        raise TimeoutError("no batch produced within 300s")
+
+    def _recycle(self):
+        if self._inflight_slot is not None:
+            try:
+                self._free_q.put(self._inflight_slot)
+            except Exception:  # noqa: BLE001
+                pass
+            self._inflight_slot = None
+
+    def close(self):
+        self._recycle()
+        for _ in self._procs:
+            try:
+                self._free_q.put(-1)  # shutdown tokens
+            except Exception:  # noqa: BLE001
+                break
+        for p in self._procs:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+        for s in self._slots:
+            s.unlink()
+            s.close()
+        self._free_q.close()
+        self._ready_q.close()

@@ -170,6 +170,15 @@ class HybridKvEmbedding(KvEmbedding):
 
         self._tick += 1
         ids = np.ascontiguousarray(ids, np.int64)
+        if insert:
+            # pin RESIDENT batch ids first: stamped with the current tick
+            # (recency only — no frequency sighting) they are demotion-
+            # proof, so promotions below can never evict a row this very
+            # batch is about to train on
+            flat = np.unique(ids)
+            resident = flat[self.store.lookup(flat) >= 0]
+            if len(resident):
+                self.store.touch_ts(resident, self._tick)
         spilled = [int(i) for i in np.unique(ids) if i in self.overflow]
         if spilled and insert:
             keys = np.array(spilled, np.int64)
@@ -217,20 +226,24 @@ class HybridKvEmbedding(KvEmbedding):
 
     # ------------------------------------------------------ import / export
 
-    def export_full(self):
-        """Hot tier + every overflow row (slot -1 marks non-resident)."""
-        blob = super().export_full()
-        extra_keys, extra_vals = [], []
-        extra_state = {k: [] for k in self.slot_state}
-        for key in list(self.overflow._rows):
+    def _collect_overflow_rows(self):
+        """(keys, stacked values, {state: stacked rows}) of the cold tier."""
+        keys, vals = [], []
+        state = {k: [] for k in self.slot_state}
+        for key in list(self.overflow._rows):  # noqa: SLF001 same package
             entry = self.overflow.get(key)
             if entry is None:
                 continue
-            extra_keys.append(key)
-            extra_vals.append(entry["value"])
-            for k in extra_state:
-                extra_state[k].append(entry.get(
-                    k, np.zeros_like(entry["value"])))
+            keys.append(key)
+            vals.append(entry["value"])
+            for k in state:
+                state[k].append(entry.get(k, np.zeros_like(entry["value"])))
+        return keys, vals, state
+
+    def export_full(self):
+        """Hot tier + every overflow row (slot -1 marks non-resident)."""
+        blob = super().export_full()
+        extra_keys, extra_vals, extra_state = self._collect_overflow_rows()
         if extra_keys:
             blob["keys"] = np.concatenate(
                 [blob["keys"], np.array(extra_keys, np.int64)])
@@ -253,17 +266,7 @@ class HybridKvEmbedding(KvEmbedding):
         the cost of their size).  The cold rows are read straight from the
         host-resident overflow — no device-table gather."""
         blob, epoch = super().export_delta()
-        extra_keys, extra_vals = [], []
-        extra_state = {k: [] for k in self.slot_state}
-        for key in list(self.overflow._rows):  # noqa: SLF001 same package
-            entry = self.overflow.get(key)
-            if entry is None:
-                continue
-            extra_keys.append(key)
-            extra_vals.append(entry["value"])
-            for k in extra_state:
-                extra_state[k].append(entry.get(
-                    k, np.zeros_like(entry["value"])))
+        extra_keys, extra_vals, extra_state = self._collect_overflow_rows()
         if extra_keys:
             blob["keys"] = np.concatenate(
                 [blob["keys"], np.array(extra_keys, np.int64)])

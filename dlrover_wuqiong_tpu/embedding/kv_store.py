@@ -94,6 +94,8 @@ def _load(so: str) -> ctypes.CDLL:
                                         i64p, ctypes.c_int64]
     lib.kv_remove.restype = ctypes.c_int64
     lib.kv_remove.argtypes = [ctypes.c_void_p, i64p, ctypes.c_int64]
+    lib.kv_touch_ts.argtypes = [ctypes.c_void_p, i64p, ctypes.c_int64,
+                                ctypes.c_uint32]
     lib.kv_export.restype = ctypes.c_int64
     lib.kv_export.argtypes = [ctypes.c_void_p, i64p, i64p, u32p, u32p,
                               ctypes.c_int64]
@@ -193,6 +195,12 @@ class NativeKvStore:
         keys = np.ascontiguousarray(keys, np.int64)
         return int(self._lib.kv_remove(self._h, _i64(keys.ravel()),
                                        keys.size))
+
+    def touch_ts(self, keys: np.ndarray, now: int):
+        """Refresh recency WITHOUT counting a frequency sighting."""
+        keys = np.ascontiguousarray(keys, np.int64)
+        self._lib.kv_touch_ts(self._h, _i64(keys.ravel()), keys.size,
+                              now & 0xFFFFFFFF)
 
     def export(self, with_meta: bool = True):
         """Returns (keys, slots[, freqs, tss])."""
@@ -345,6 +353,13 @@ class PyKvStore:
                     self._free.append(s)
                     removed += 1
         return removed
+
+    def touch_ts(self, keys, now: int):
+        with self._lock:
+            for k in np.ascontiguousarray(keys, np.int64).ravel().tolist():
+                s = self._map.get(int(k))
+                if s is not None:
+                    self._ts[s] = now & 0xFFFFFFFF
 
     def export(self, with_meta=True):
         keys = np.array(list(self._map.keys()), np.int64)

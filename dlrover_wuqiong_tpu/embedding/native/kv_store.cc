@@ -97,6 +97,11 @@ class KvStore {
     return it == s.map.end() ? -1 : it->second;
   }
 
+  void set_ts(int64_t slot, uint32_t now) {
+    if (slot >= 0 && slot < capacity_)
+      ts_[slot].store(now, std::memory_order_relaxed);
+  }
+
   void touch(int64_t slot, uint32_t now) {
     freq_[slot].fetch_add(1, std::memory_order_relaxed);
     ts_[slot].store(now, std::memory_order_relaxed);
@@ -382,6 +387,17 @@ int64_t kv_remove(void* h, const int64_t* keys, int64_t n) {
   auto* st = static_cast<KvStore*>(h);
   std::shared_lock<std::shared_mutex> g(st->global_mu());
   return st->remove_keys(keys, n);
+}
+
+// Refresh last-seen timestamps WITHOUT counting a frequency sighting
+// (recency pinning, e.g. demotion protection for the current batch).
+void kv_touch_ts(void* h, const int64_t* keys, int64_t n, uint32_t now) {
+  auto* st = static_cast<KvStore*>(h);
+  std::shared_lock<std::shared_mutex> g(st->global_mu());
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t slot = st->lookup(keys[i]);
+    if (slot >= 0) st->set_ts(slot, now);
+  }
 }
 
 int64_t kv_export(void* h, int64_t* keys, int64_t* slots, uint32_t* freqs,

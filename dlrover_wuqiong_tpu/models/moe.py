@@ -33,11 +33,15 @@ class MoEConfig:
     capacity_factor: float = 1.25
     aux_loss_weight: float = 0.01
     dtype: Any = jnp.bfloat16
+    # "capacity": GShard dense dispatch (einsum, drops overflow tokens)
+    # "grouped": dropless sort + grouped-GEMM via lax.ragged_dot (parity
+    #   atorch modules/moe/grouped_gemm_moe.py)
+    impl: str = "capacity"
 
 
 def top_k_gating(logits: jax.Array, k: int, capacity: int,
-                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Returns (combine (T, E, C), dispatch bool (T, E, C), aux_loss).
+                 ) -> Tuple[jax.Array, jax.Array]:
+    """Returns (combine (T, E, C), dispatch bool (T, E, C)).
 
     T tokens, E experts, C capacity per expert.  Tokens beyond an expert's
     capacity are dropped (standard GShard semantics).
@@ -73,16 +77,46 @@ def top_k_gating(logits: jax.Array, k: int, capacity: int,
                                > 0)
         masked = jnp.where(onehot > 0, -jnp.inf, masked)
 
-    # Switch-style load balance loss on the top-1 assignment distribution
-    top1 = jax.nn.one_hot(jnp.argmax(probs, -1), E, dtype=jnp.float32)
-    frac_tokens = top1.mean(axis=0)
-    frac_probs = probs.mean(axis=0)
-    aux = (frac_tokens * frac_probs).sum() * (E * E)
-
     # renormalize combine weights over the selected experts (top-k > 1)
+    # (the Switch load-balance aux loss lives in MoEMLP, the one place
+    # that owns the router probs)
     denom = combine.sum(axis=(1, 2), keepdims=True)
     combine = combine / jnp.where(denom > 0, denom, 1.0)
-    return combine, dispatch, aux
+    return combine, dispatch
+
+
+def grouped_moe(tokens: jax.Array, probs: jax.Array, w_gate: jax.Array,
+                w_in: jax.Array, w_down: jax.Array, top_k: int
+                ) -> jax.Array:
+    """Dropless MoE via sort + grouped GEMM (`jax.lax.ragged_dot`).
+
+    Parity: reference `atorch/atorch/modules/moe/grouped_gemm_moe.py` —
+    tokens sorted by expert, one grouped matmul per projection, no
+    capacity limit so nothing is dropped.  On TPU `ragged_dot` lowers to
+    the MXU's grouped-matmul path; the sort/unsort are cheap gathers.
+
+    tokens (T, d); probs (T, E) router softmax; w_gate/w_in (E, d, f);
+    w_down (E, f, d).  Returns (T, d).
+    """
+    T, d = tokens.shape
+    E = probs.shape[-1]
+    gates, experts = jax.lax.top_k(probs, top_k)       # (T, k) each
+    gates = gates / jnp.maximum(gates.sum(-1, keepdims=True), 1e-9)
+
+    flat_expert = experts.reshape(-1)                  # (T*k,)
+    order = jnp.argsort(flat_expert)                   # stable per expert
+    token_idx = order // top_k                         # source token of row
+    group_sizes = jnp.bincount(flat_expert, length=E)
+
+    xs = tokens[token_idx].astype(w_in.dtype)          # (T*k, d) sorted
+    h = jax.nn.silu(jax.lax.ragged_dot(xs, w_gate, group_sizes)) * \
+        jax.lax.ragged_dot(xs, w_in, group_sizes)
+    ys = jax.lax.ragged_dot(h, w_down, group_sizes)    # (T*k, d)
+
+    flat_gates = gates.reshape(-1)[order].astype(ys.dtype)
+    out = jax.ops.segment_sum(ys * flat_gates[:, None], token_idx,
+                              num_segments=T)
+    return out.astype(tokens.dtype)
 
 
 class MoEMLP(nn.Module):
@@ -109,9 +143,6 @@ class MoEMLP(nn.Module):
         router = nn.Dense(cfg.num_experts, use_bias=False,
                           dtype=jnp.float32, name="router")
         logits = router(tokens.astype(jnp.float32))
-        combine, dispatch, aux = top_k_gating(logits, cfg.top_k, capacity)
-        self.sow("intermediates", "moe_aux_loss",
-                 aux * cfg.aux_loss_weight)
 
         w_in = self.param(
             "experts_w_in", nn.initializers.normal(0.02),
@@ -123,6 +154,20 @@ class MoEMLP(nn.Module):
             "experts_w_down", nn.initializers.normal(0.02),
             (cfg.num_experts, self.ffn, d)).astype(cfg.dtype)
 
+        probs = jax.nn.softmax(logits, axis=-1)
+        # Switch-style load-balance loss (shared by both impls)
+        top1 = jax.nn.one_hot(jnp.argmax(probs, -1), cfg.num_experts,
+                              dtype=jnp.float32)
+        aux = (top1.mean(0) * probs.mean(0)).sum() * cfg.num_experts ** 2
+        self.sow("intermediates", "moe_aux_loss",
+                 aux * cfg.aux_loss_weight)
+
+        if cfg.impl == "grouped":
+            out = grouped_moe(tokens, probs, w_gate, w_in, w_out,
+                              cfg.top_k)
+            return out.reshape(B, T, d)
+
+        combine, dispatch = top_k_gating(logits, cfg.top_k, capacity)
         # dispatch: (T, E, C) x (T, d) -> (E, C, d)
         xe = jnp.einsum("tec,td->ecd", dispatch.astype(cfg.dtype),
                         tokens.astype(cfg.dtype))

@@ -104,7 +104,7 @@ class RaySchedulerClient(SchedulerClient):
         return True
 
     def list_nodes(self) -> List[Node]:
-        self._poll()
+        self._poll()  # events land on the queue for watch() consumers
         with self._lock:
             return list(self._nodes.values())
 
@@ -118,16 +118,20 @@ class RaySchedulerClient(SchedulerClient):
                     got = True
             except queue.Empty:
                 pass
-            events = self._poll()
-            for e in events:
-                yield e
-            if events or got:
+            if self._poll() or got:
+                try:
+                    while True:
+                        yield self._events.get_nowait()
+                except queue.Empty:
+                    pass
                 deadline = time.time() + timeout
             else:
                 time.sleep(0.05)
 
-    def _poll(self) -> List[NodeEvent]:
-        """Check actor run() futures for completion (parity ActorWatcher)."""
+    def _poll(self) -> int:
+        """Check actor run() futures; terminal transitions go to the event
+        QUEUE (never returned-and-dropped — a list_nodes() caller must not
+        swallow events a watch() consumer needs).  Returns #events."""
         ray = self._ray
         events = []
         with self._lock:
@@ -152,7 +156,9 @@ class RaySchedulerClient(SchedulerClient):
             if code != 0 and not node.exit_reason:
                 node.exit_reason = f"exit_code={code}"
             events.append(NodeEvent(NodeEventType.MODIFIED, node))
-        return events
+        for e in events:
+            self._events.put(e)
+        return len(events)
 
     def close(self):
         with self._lock:

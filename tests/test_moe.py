@@ -18,7 +18,7 @@ from dlrover_wuqiong_tpu.models.moe import MoEConfig, MoEMLP, top_k_gating
 class TestTopKGating:
     def test_top1_each_token_dispatched_once(self):
         logits = jax.random.normal(jax.random.PRNGKey(0), (16, 4))
-        combine, dispatch, aux = top_k_gating(logits, k=1, capacity=16)
+        combine, dispatch = top_k_gating(logits, k=1, capacity=16)
         # every token lands in exactly one (expert, slot)
         assert dispatch.sum() == 16
         np.testing.assert_allclose(combine.sum(axis=(1, 2)),
@@ -26,7 +26,7 @@ class TestTopKGating:
 
     def test_top2_combine_normalized(self):
         logits = jax.random.normal(jax.random.PRNGKey(1), (32, 8))
-        combine, dispatch, aux = top_k_gating(logits, k=2, capacity=32)
+        combine, dispatch = top_k_gating(logits, k=2, capacity=32)
         assert int(dispatch.sum()) == 64  # 2 slots per token
         np.testing.assert_allclose(combine.sum(axis=(1, 2)),
                                    np.ones(32), atol=1e-6)
@@ -35,23 +35,36 @@ class TestTopKGating:
         # all tokens prefer expert 0; capacity 4 keeps only 4
         logits = jnp.stack([jnp.full((16,), 5.0)] + [jnp.zeros(16)] * 3,
                            axis=1)
-        combine, dispatch, aux = top_k_gating(logits, k=1, capacity=4)
+        combine, dispatch = top_k_gating(logits, k=1, capacity=4)
         assert int(dispatch[:, 0].sum()) == 4
 
     def test_no_slot_collisions(self):
         logits = jax.random.normal(jax.random.PRNGKey(2), (64, 4))
-        combine, dispatch, aux = top_k_gating(logits, k=2, capacity=64)
+        combine, dispatch = top_k_gating(logits, k=2, capacity=64)
         # each (expert, slot) holds at most one token
         per_slot = dispatch.sum(axis=0)
         assert int(per_slot.max()) <= 1
 
     def test_aux_loss_penalizes_imbalance(self):
-        balanced = jnp.tile(jnp.eye(4), (4, 1)) * 4.0
-        skewed = jnp.stack([jnp.full((16,), 4.0)] + [jnp.zeros(16)] * 3,
-                           axis=1)
-        _, _, aux_b = top_k_gating(balanced, 1, 16)
-        _, _, aux_s = top_k_gating(skewed, 1, 16)
-        assert float(aux_s) > float(aux_b)
+        """The Switch aux loss (sown by MoEMLP) must be larger for skewed
+        than for balanced routing."""
+        import jax
+
+        def sown_aux(router_kernel):
+            cfg = MoEConfig(num_experts=4, top_k=1, dtype=jnp.float32)
+            mlp = MoEMLP(hidden=4, ffn=8, moe=cfg)
+            x = jnp.ones((1, 16, 4))
+            params = mlp.init(jax.random.PRNGKey(0), x)["params"]
+            params["router"]["kernel"] = router_kernel
+            _, upd = mlp.apply({"params": params}, x,
+                               mutable=["intermediates"])
+            return float(jax.tree.leaves(
+                upd["intermediates"]["moe_aux_loss"])[0])
+
+        # uniform tokens: router weights decide the distribution entirely
+        balanced = jnp.eye(4)           # argmax varies... all tokens equal
+        skewed = jnp.zeros((4, 4)).at[:, 0].set(5.0)
+        assert sown_aux(skewed) > sown_aux(balanced) - 1e-6
 
 
 class TestMoEMLP:
@@ -102,3 +115,84 @@ class TestMoETraining:
         nd = sum(x.size for x in jax.tree.leaves(pd))
         nm = sum(x.size for x in jax.tree.leaves(pm))
         assert nm > nd  # experts multiply MLP params
+
+
+class TestGroupedMoE:
+    """Dropless grouped-GEMM path (parity grouped_gemm_moe.py)."""
+
+    def test_matches_explicit_expert_loop(self):
+        import jax
+        from dlrover_wuqiong_tpu.models.moe import grouped_moe
+
+        T, d, f, E, k = 16, 8, 16, 4, 2
+        key = jax.random.PRNGKey(0)
+        ks = jax.random.split(key, 5)
+        tokens = jax.random.normal(ks[0], (T, d))
+        probs = jax.nn.softmax(jax.random.normal(ks[1], (T, E)), -1)
+        w_gate = jax.random.normal(ks[2], (E, d, f)) * 0.1
+        w_in = jax.random.normal(ks[3], (E, d, f)) * 0.1
+        w_down = jax.random.normal(ks[4], (E, f, d)) * 0.1
+
+        got = grouped_moe(tokens, probs, w_gate, w_in, w_down, k)
+
+        # explicit reference: per token, run its top-k experts densely
+        gates, experts = jax.lax.top_k(probs, k)
+        gates = gates / gates.sum(-1, keepdims=True)
+        want = np.zeros((T, d), np.float32)
+        for t in range(T):
+            for j in range(k):
+                e = int(experts[t, j])
+                x = tokens[t]
+                h = jax.nn.silu(x @ w_gate[e]) * (x @ w_in[e])
+                want[t] += float(gates[t, j]) * np.asarray(h @ w_down[e])
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-4)
+
+    def test_no_tokens_dropped_under_imbalance(self):
+        """Every token contributes even when one expert takes the whole
+        batch (the capacity impl would drop overflow)."""
+        import jax
+        from dlrover_wuqiong_tpu.models.moe import grouped_moe
+
+        T, d, f, E = 32, 4, 8, 4
+        tokens = jnp.ones((T, d))
+        # router sends EVERYTHING to expert 0
+        probs = jnp.zeros((T, E)).at[:, 0].set(1.0)
+        w = jnp.ones((E, d, f)) * 0.1
+        wd = jnp.ones((E, f, d)) * 0.1
+        out = grouped_moe(tokens, probs, w, w, wd, 1)
+        # all rows identical and nonzero — nothing dropped
+        assert float(jnp.abs(out).sum()) > 0
+        np.testing.assert_allclose(np.asarray(out[0]), np.asarray(out[-1]),
+                                   atol=1e-6)
+
+    def test_grouped_impl_trains_in_model(self):
+        import dataclasses as dc
+
+        import jax
+        import optax
+        from dlrover_wuqiong_tpu.models.moe import MoEConfig, MoEMLP
+
+        cfg = MoEConfig(num_experts=4, top_k=2, dtype=jnp.float32,
+                        impl="grouped")
+        mlp = MoEMLP(hidden=8, ffn=16, moe=cfg)
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 8))
+        params = mlp.init(jax.random.PRNGKey(1), x)["params"]
+        target = jnp.ones((2, 8, 8))
+        opt = optax.adam(1e-2)
+        state = opt.init(params)
+
+        @jax.jit
+        def step(params, state):
+            def loss_fn(p):
+                y, upd = mlp.apply({"params": p}, x,
+                                   mutable=["intermediates"])
+                return ((y - target) ** 2).mean()
+            loss, g = jax.value_and_grad(loss_fn)(params)
+            updates, state = opt.update(g, state, params)
+            return optax.apply_updates(params, updates), state, loss
+
+        losses = []
+        for _ in range(30):
+            params, state, loss = step(params, state)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.5

@@ -1,0 +1,56 @@
+"""Shm coworker data loader tests (reference atorch data/shm_dataloader.py
+parity): batches produced in sidecar processes arrive intact through
+shared memory, slots recycle, shutdown is clean.
+"""
+
+import numpy as np
+import pytest
+
+from dlrover_wuqiong_tpu.data.shm_loader import ShmCoworkerLoader
+
+
+def _produce(worker_id, step):
+    rng = np.random.default_rng(step)
+    return {
+        "input_ids": rng.integers(0, 100, (4, 8)).astype(np.int32),
+        "labels": np.full((4, 8), step, np.int64),
+    }
+
+
+class TestShmCoworkerLoader:
+    def test_batches_arrive_intact(self):
+        example = _produce(0, 0)
+        loader = ShmCoworkerLoader(_produce, example, num_workers=2,
+                                   depth=4, max_steps=8)
+        seen = []
+        try:
+            for batch in loader:
+                assert batch["input_ids"].shape == (4, 8)
+                step = int(batch["labels"][0, 0])
+                np.testing.assert_array_equal(
+                    batch["input_ids"], _produce(0, step)["input_ids"])
+                seen.append(step)
+        finally:
+            loader.close()
+        # every step 0..7 arrives exactly once (order may interleave)
+        assert sorted(seen) == list(range(8))
+
+    def test_slot_recycling_beyond_depth(self):
+        example = _produce(0, 0)
+        loader = ShmCoworkerLoader(_produce, example, num_workers=1,
+                                   depth=2, max_steps=10)
+        count = 0
+        try:
+            for batch in loader:
+                count += 1
+        finally:
+            loader.close()
+        assert count == 10  # 10 batches through 2 slots
+
+    def test_clean_shutdown_midstream(self):
+        example = _produce(0, 0)
+        loader = ShmCoworkerLoader(_produce, example, num_workers=2,
+                                   depth=3, max_steps=-1)
+        got = next(loader)
+        assert got["input_ids"].shape == (4, 8)
+        loader.close()  # must not hang with producers running
