@@ -75,6 +75,10 @@ class StrategyContext:
         if self.flash_attention is not None and \
                 "use_flash_attention" in fields:
             out["use_flash_attention"] = self.flash_attention
+        if self.extra.get("fp8") and "fp8" in fields:
+            out["fp8"] = True
+            if self.extra.get("fp8_filter") and "fp8_filter" in fields:
+                out["fp8_filter"] = self.extra["fp8_filter"]
         return {k: v for k, v in out.items() if getattr(cfg, k) != v}
 
 
@@ -123,10 +127,18 @@ def _s_local_sgd(ctx: StrategyContext, cfg: Dict, num_devices: int):
     ctx.extra["local_sgd"] = dict(cfg)
 
 
+@register_strategy("amp")
 @register_strategy("amp_native")
 @register_strategy("half")
 def _s_amp(ctx: StrategyContext, cfg: Dict, num_devices: int):
+    """bf16 compute; with {"fp8": True} additionally routes the name-filtered
+    projections through Fp8Dense (parity: reference Fp8Optimization module
+    filter, amp_optimization.py:197-260)."""
     ctx.amp = cfg.get("enabled", True)
+    if cfg.get("fp8"):
+        ctx.extra["fp8"] = True
+        if cfg.get("filter"):
+            ctx.extra["fp8_filter"] = tuple(cfg["filter"])
 
 
 @register_strategy("checkpoint")

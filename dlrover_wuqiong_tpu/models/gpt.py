@@ -33,6 +33,10 @@ class GPTConfig:
     use_flash_attention: bool = True
     attn_impl: str = "flash"  # "flash" | "ring" | "ulysses"
     mesh: Any = None  # required by ring/ulysses (set by auto_accelerate)
+    # fp8 matmuls on the name-filtered projections (models/fp8.py; set by
+    # the ("amp", {"fp8": True}) strategy)
+    fp8: bool = False
+    fp8_filter: tuple = ("c_attn", "c_proj", "c_fc")
     # MoE: 0 experts = dense MLP (parity atorch modules/moe)
     moe_experts: int = 0
     moe_top_k: int = 2
@@ -75,9 +79,11 @@ class CausalSelfAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, deterministic: bool = True):
+        from .fp8 import dense
+
         cfg = self.config
         B, T, C = x.shape
-        qkv = nn.Dense(3 * C, dtype=cfg.dtype, name="c_attn")(x)
+        qkv = dense(cfg, 3 * C, "c_attn")(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(B, T, cfg.n_head, cfg.head_dim)
         k = k.reshape(B, T, cfg.n_head, cfg.head_dim)
@@ -95,7 +101,7 @@ class CausalSelfAttention(nn.Module):
                                  axis=-1).astype(cfg.dtype)
             y = jnp.einsum("bhqk,bkhd->bqhd", att, v)
         y = y.reshape(B, T, C)
-        y = nn.Dense(C, dtype=cfg.dtype, name="c_proj")(y)
+        y = dense(cfg, C, "c_proj")(y)
         if cfg.dropout > 0:
             y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
         return y
@@ -106,10 +112,12 @@ class MLP(nn.Module):
 
     @nn.compact
     def __call__(self, x, deterministic: bool = True):
+        from .fp8 import dense
+
         cfg = self.config
-        h = nn.Dense(4 * cfg.n_embd, dtype=cfg.dtype, name="c_fc")(x)
+        h = dense(cfg, 4 * cfg.n_embd, "c_fc")(x)
         h = jax.nn.gelu(h)
-        h = nn.Dense(cfg.n_embd, dtype=cfg.dtype, name="c_proj")(h)
+        h = dense(cfg, cfg.n_embd, "c_proj")(h)
         if cfg.dropout > 0:
             h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
         return h

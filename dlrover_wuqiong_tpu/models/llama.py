@@ -34,6 +34,11 @@ class LlamaConfig:
     use_flash_attention: bool = True
     attn_impl: str = "flash"  # "flash" | "ring" | "ulysses"
     mesh: Any = None  # required by ring/ulysses (set by auto_accelerate)
+    # fp8 matmuls on the name-filtered projections (models/fp8.py; set by
+    # the ("amp", {"fp8": True}) strategy)
+    fp8: bool = False
+    fp8_filter: tuple = ("q_proj", "k_proj", "v_proj", "o_proj",
+                         "gate_proj", "up_proj", "down_proj")
 
     @classmethod
     def nano(cls):
@@ -101,15 +106,17 @@ class LlamaAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, cos, sin):
+        from .fp8 import dense
+
         cfg = self.config
         B, T, C = x.shape
         hd = cfg.head_dim
-        q = nn.Dense(cfg.num_heads * hd, use_bias=False, dtype=cfg.dtype,
-                     name="q_proj")(x).reshape(B, T, cfg.num_heads, hd)
-        k = nn.Dense(cfg.num_kv_heads * hd, use_bias=False, dtype=cfg.dtype,
-                     name="k_proj")(x).reshape(B, T, cfg.num_kv_heads, hd)
-        v = nn.Dense(cfg.num_kv_heads * hd, use_bias=False, dtype=cfg.dtype,
-                     name="v_proj")(x).reshape(B, T, cfg.num_kv_heads, hd)
+        q = dense(cfg, cfg.num_heads * hd, "q_proj", use_bias=False)(
+            x).reshape(B, T, cfg.num_heads, hd)
+        k = dense(cfg, cfg.num_kv_heads * hd, "k_proj", use_bias=False)(
+            x).reshape(B, T, cfg.num_kv_heads, hd)
+        v = dense(cfg, cfg.num_kv_heads * hd, "v_proj", use_bias=False)(
+            x).reshape(B, T, cfg.num_kv_heads, hd)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         # GQA: repeat kv heads
@@ -129,7 +136,7 @@ class LlamaAttention(nn.Module):
             att = jax.nn.softmax(att, axis=-1).astype(cfg.dtype)
             y = jnp.einsum("bhqk,bkhd->bqhd", att, v)
         y = y.reshape(B, T, cfg.num_heads * hd)
-        return nn.Dense(C, use_bias=False, dtype=cfg.dtype, name="o_proj")(y)
+        return dense(cfg, C, "o_proj", use_bias=False)(y)
 
 
 class LlamaMLP(nn.Module):
@@ -137,14 +144,14 @@ class LlamaMLP(nn.Module):
 
     @nn.compact
     def __call__(self, x):
+        from .fp8 import dense
+
         cfg = self.config
-        gate = nn.Dense(cfg.intermediate_size, use_bias=False,
-                        dtype=cfg.dtype, name="gate_proj")(x)
-        up = nn.Dense(cfg.intermediate_size, use_bias=False,
-                      dtype=cfg.dtype, name="up_proj")(x)
+        gate = dense(cfg, cfg.intermediate_size, "gate_proj",
+                     use_bias=False)(x)
+        up = dense(cfg, cfg.intermediate_size, "up_proj", use_bias=False)(x)
         h = jax.nn.silu(gate) * up
-        return nn.Dense(cfg.hidden_size, use_bias=False, dtype=cfg.dtype,
-                        name="down_proj")(h)
+        return dense(cfg, cfg.hidden_size, "down_proj", use_bias=False)(h)
 
 
 class LlamaBlock(nn.Module):

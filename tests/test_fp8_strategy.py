@@ -1,0 +1,133 @@
+"""FP8 training as a reachable strategy (round-3 VERDICT item #2).
+
+Parity: reference `atorch/auto/opt_lib/amp_optimization.py:197-260`
+(Fp8Optimization module filter).  Here ("amp", {"fp8": True}) rebuilds the
+model with fp8 projections; these tests pin (a) param-tree compatibility so
+sharding rules still bind, (b) numerics vs bf16 within a loss-delta bound,
+(c) end-to-end reachability through auto_accelerate incl. tensor parallel.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import flatten_util
+import numpy as np
+import optax
+import pytest
+
+from dlrover_wuqiong_tpu.auto.accelerate import auto_accelerate
+from dlrover_wuqiong_tpu.models.fp8 import Fp8Dense, fp8_selected
+from dlrover_wuqiong_tpu.models.gpt import GPT, GPTConfig, cross_entropy_loss
+from dlrover_wuqiong_tpu.models.llama import Llama, LlamaConfig
+
+import dataclasses
+
+
+def _batch(cfg, key=0, batch=4, seq=32):
+    data = jax.random.randint(jax.random.PRNGKey(key), (batch, seq + 1), 0,
+                              cfg.vocab_size)
+    return data[:, :-1], data[:, 1:]
+
+
+def test_param_tree_identical_to_bf16():
+    cfg = GPTConfig.nano()
+    p_bf16 = GPT(cfg).init_params(jax.random.PRNGKey(0))
+    p_fp8 = GPT(dataclasses.replace(cfg, fp8=True)).init_params(
+        jax.random.PRNGKey(0))
+    flat_a = jax.tree_util.tree_leaves_with_path(p_bf16)
+    flat_b = jax.tree_util.tree_leaves_with_path(p_fp8)
+    assert [(jax.tree_util.keystr(k), v.shape, v.dtype)
+            for k, v in flat_a] == \
+           [(jax.tree_util.keystr(k), v.shape, v.dtype) for k, v in flat_b]
+    # same init → identical master weights
+    for (_, a), (_, b) in zip(flat_a, flat_b):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("model_cls,cfg", [
+    (GPT, GPTConfig.nano()),
+    (Llama, LlamaConfig.nano()),
+])
+def test_fp8_numerics_close_to_bf16(model_cls, cfg):
+    params = model_cls(cfg).init_params(jax.random.PRNGKey(0))
+    ids, labels = _batch(cfg)
+    logits_ref = model_cls(cfg).apply({"params": params}, ids)
+    cfg8 = dataclasses.replace(cfg, fp8=True)
+    logits_fp8 = model_cls(cfg8).apply({"params": params}, ids)
+    loss_ref = float(cross_entropy_loss(logits_ref, labels))
+    loss_fp8 = float(cross_entropy_loss(logits_fp8, labels))
+    assert np.isfinite(loss_fp8)
+    # fp8 rounding noise, not divergence: e4m3 keeps ~2 decimal digits
+    assert abs(loss_fp8 - loss_ref) / loss_ref < 0.05, \
+        (loss_fp8, loss_ref)
+
+
+def test_fp8_grads_finite_and_close():
+    cfg = GPTConfig.nano()
+    params = GPT(cfg).init_params(jax.random.PRNGKey(0))
+    ids, labels = _batch(cfg)
+
+    def loss_fn(c):
+        def f(p):
+            return cross_entropy_loss(
+                GPT(c).apply({"params": p}, ids), labels)
+        return f
+
+    g_ref = jax.grad(loss_fn(cfg))(params)
+    g_fp8 = jax.grad(loss_fn(dataclasses.replace(cfg, fp8=True)))(params)
+    ref_flat, _ = flatten_util.ravel_pytree(g_ref)
+    fp8_flat, _ = flatten_util.ravel_pytree(g_fp8)
+    assert np.all(np.isfinite(np.asarray(fp8_flat, np.float32)))
+    cos = float(jnp.vdot(ref_flat.astype(jnp.float32),
+                         fp8_flat.astype(jnp.float32)) /
+                (jnp.linalg.norm(ref_flat.astype(jnp.float32)) *
+                 jnp.linalg.norm(fp8_flat.astype(jnp.float32)) + 1e-12))
+    assert cos > 0.97, cos  # e5m2 gradient rounding, same direction
+
+
+def test_fp8_filter_selects_projections_only():
+    cfg = GPTConfig(fp8=True)
+    assert fp8_selected(cfg, "c_attn")
+    assert fp8_selected(cfg, "c_fc")
+    assert fp8_selected(cfg, "c_proj")
+    assert not fp8_selected(cfg, "wte")
+    assert not fp8_selected(cfg, "lm_head")
+    custom = dataclasses.replace(cfg, fp8_filter=("c_fc",))
+    assert fp8_selected(custom, "c_fc")
+    assert not fp8_selected(custom, "c_attn")
+
+
+def test_amp_fp8_strategy_reachable_with_tp():
+    """auto_accelerate(("amp", {"fp8": True})) must rebuild the model with
+    fp8 projections and train under tp=2 x fsdp sharding."""
+    devices = jax.devices()[:8]
+    cfg = GPTConfig(vocab_size=512, n_layer=2, n_head=4, n_embd=128,
+                    block_size=64, dtype=jnp.float32)
+    res = auto_accelerate(
+        GPT(cfg), optimizer=optax.adamw(1e-3),
+        strategy=[("amp", {"fp8": True}),
+                  ("tensor_parallel", {"size": 2}),
+                  ("fsdp", {})],
+        devices=devices)
+    assert res.model.config.fp8 is True
+    assert res.strategy.amp is True
+    ids, labels = _batch(res.model.config, batch=8, seq=32)
+    batch = res.place_batch({"input_ids": ids, "labels": labels})
+    state, metrics = res.train_step(res.state, batch)
+    loss0 = float(metrics["loss"])
+    assert np.isfinite(loss0)
+    # a couple more steps must stay finite and trend down on memorized data
+    for _ in range(8):
+        state, metrics = res.train_step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+    assert float(metrics["loss"]) < loss0
+
+
+def test_fp8_custom_filter_through_strategy():
+    devices = jax.devices()[:2]
+    cfg = GPTConfig(vocab_size=512, n_layer=1, n_head=2, n_embd=64,
+                    block_size=32, dtype=jnp.float32)
+    res = auto_accelerate(
+        GPT(cfg), optimizer=optax.sgd(1e-3),
+        strategy=[("amp", {"fp8": True, "filter": ["c_fc"]}), ("fsdp", {})],
+        devices=devices)
+    assert res.model.config.fp8_filter == ("c_fc",)
