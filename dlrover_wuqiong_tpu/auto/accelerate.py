@@ -115,8 +115,16 @@ def _s_ep(ctx: StrategyContext, cfg: Dict, num_devices: int):
 
 @register_strategy("pipeline_parallel")
 def _s_pp(ctx: StrategyContext, cfg: Dict, num_devices: int):
+    """cfg: size, microbatches, schedule ("gpipe" | "interleaved" | "1f1b"),
+    virtual_stages (interleaved chunk count per device)."""
     ctx.plan.pp = cfg.get("size", 1)
     ctx.extra["pp_microbatches"] = cfg.get("microbatches")
+    schedule = cfg.get("schedule", "gpipe")
+    if schedule not in ("gpipe", "interleaved", "1f1b"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r} — expected "
+                         "'gpipe', 'interleaved' or '1f1b'")
+    ctx.extra["pp_schedule"] = schedule
+    ctx.extra["pp_virtual_stages"] = cfg.get("virtual_stages", 1)
 
 
 @register_strategy("local_sgd")
@@ -304,11 +312,26 @@ def auto_accelerate(
                 f"pp={ctx.plan.pp}")
         microbatches = ctx.extra.get("pp_microbatches") or max(
             ctx.accum_steps, 2 * ctx.plan.pp)
-        model = PipelinedLM(model, mesh, microbatches)
+        pp_schedule = ctx.extra.get("pp_schedule", "gpipe")
+        pp_virtual = ctx.extra.get("pp_virtual_stages", 1)
+        if pp_schedule == "1f1b":
+            if loss_fn is not None:
+                raise ValueError(
+                    "pipeline schedule '1f1b' computes its own head loss "
+                    "(cross-entropy) inside the schedule and cannot honor a "
+                    "custom loss_fn — use schedule='gpipe'/'interleaved'")
+            if ctx.extra.get("local_sgd") is not None:
+                raise ValueError(
+                    "pipeline schedule '1f1b' does not compose with "
+                    "local_sgd — its manual grads bypass the DiLoCo step")
+        model = PipelinedLM(model, mesh, microbatches,
+                            schedule=pp_schedule,
+                            virtual_stages=pp_virtual)
         planner = PipelineShardingPlanner(planner)
         logger.info("pipeline parallel: %d stages x %d layers, %d "
-                    "microbatches", ctx.plan.pp, n_layer // ctx.plan.pp,
-                    microbatches)
+                    "microbatches, schedule=%s%s", ctx.plan.pp,
+                    n_layer // ctx.plan.pp, microbatches, pp_schedule,
+                    f" v={pp_virtual}" if pp_virtual > 1 else "")
 
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     params = model.init_params(rng)
@@ -342,8 +365,13 @@ def auto_accelerate(
     else:
         state = TrainState.create(params, optimizer)
         state, state_sh = shard_train_state(state, planner)
+        vg_fn = None
+        if ctx.plan.pp > 1 and ctx.extra.get("pp_schedule") == "1f1b":
+            # manual fwd/bwd interleave replaces autodiff-through-apply
+            vg_fn = model.value_and_grad
         step = make_train_step(loss, optimizer, mesh, planner,
-                               accum_steps=ctx.accum_steps)
+                               accum_steps=ctx.accum_steps,
+                               value_and_grad_fn=vg_fn)
     logger.info("auto_accelerate: mesh=%s params=%s accum=%d",
                 ctx.plan.describe(),
                 f"{num_params:,}" if num_params else "?", ctx.accum_steps)

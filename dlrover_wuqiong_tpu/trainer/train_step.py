@@ -41,14 +41,19 @@ def make_train_step(
     planner: Optional[ShardingPlanner] = None,
     accum_steps: int = 1,
     donate: bool = True,
+    value_and_grad_fn: Optional[Callable] = None,
 ):
     """Returns jit'd `step(state, batch) -> (state, metrics)`.
 
     `batch` leaves have a leading microbatch axis of size `accum_steps` when
     accumulation is on: shape (accum, per_device_batch * data_axes, ...).
+    `value_and_grad_fn(params, batch) -> (loss, grads)` overrides the default
+    autodiff path (used by the manual 1F1B pipeline schedule).
     """
 
     def _grads(params, batch):
+        if value_and_grad_fn is not None:
+            return value_and_grad_fn(params, batch)
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
         return loss, grads
 
