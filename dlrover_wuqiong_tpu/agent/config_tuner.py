@@ -78,3 +78,35 @@ def read_paral_config(path: Optional[str] = None) -> Optional[dict]:
             return json.load(f)
     except (OSError, ValueError):
         return None
+
+
+class ParalConfigListener:
+    """Trainer-side change detector over the tuner file.
+
+    Parity: reference `trainer/torch/elastic/dataloader.py:97-133` — the
+    ElasticDataLoader's `load_config` hook that picks up the master's tuned
+    batch size between steps.  `poll()` returns the parsed config dict only
+    when its content changed since the last call (None otherwise), so the
+    training loop can apply changes exactly once.
+    """
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path or os.getenv(ConfigPath.ENV_PARAL_CONFIG,
+                                      ConfigPath.PARAL_CONFIG_DEFAULT)
+        self._last: Optional[str] = None
+
+    def poll(self) -> Optional[dict]:
+        try:
+            with open(self.path) as f:
+                payload = f.read()
+        except OSError:
+            return None
+        if payload == self._last:
+            return None
+        try:
+            cfg = json.loads(payload)
+        except ValueError:
+            return None  # mid-write torn read can't happen (atomic replace),
+            # but tolerate hand-edited files
+        self._last = payload
+        return cfg

@@ -123,8 +123,15 @@ def _s_pp(ctx: StrategyContext, cfg: Dict, num_devices: int):
     if schedule not in ("gpipe", "interleaved", "1f1b"):
         raise ValueError(f"unknown pipeline schedule {schedule!r} — expected "
                          "'gpipe', 'interleaved' or '1f1b'")
+    virtual = cfg.get("virtual_stages", 2 if schedule == "interleaved" else 1)
+    if schedule == "interleaved" and virtual < 2:
+        raise ValueError("interleaved schedule needs virtual_stages >= 2 — "
+                         "with 1 chunk per device it degenerates to gpipe")
+    if schedule != "interleaved" and virtual > 1:
+        raise ValueError(f"virtual_stages={virtual} only applies to "
+                         "schedule='interleaved'")
     ctx.extra["pp_schedule"] = schedule
-    ctx.extra["pp_virtual_stages"] = cfg.get("virtual_stages", 1)
+    ctx.extra["pp_virtual_stages"] = virtual
 
 
 @register_strategy("local_sgd")

@@ -241,10 +241,6 @@ def _interleaved_apply(block_fn, stacked_params, xm, mesh, v):
 # ------------------------------------------------------------ 1F1B training
 
 
-def _tree_add(a, b):
-    return jax.tree.map(jnp.add, a, b)
-
-
 def _tree_zeros_like(t):
     return jax.tree.map(jnp.zeros_like, t)
 

@@ -50,6 +50,8 @@ class TrainingArgs:
     profile_start_step: int = -1
     profile_end_step: int = -1
     save_on_exit: bool = True
+    tune_config_steps: int = 25              # poll master's paral config
+    # every k steps (0 = off); applies dataloader batch size + ckpt cadence
 
 
 class Trainer:
@@ -104,6 +106,52 @@ class Trainer:
             start_step=args.profile_start_step,
             end_step=args.profile_end_step)
 
+        # master-tuned runtime config (batch size / ckpt cadence) — closes
+        # the loop master → agent ParalConfigTuner → file → trainer
+        from ..agent.config_tuner import ParalConfigListener
+
+        self._tune_listener = (ParalConfigListener()
+                               if args.tune_config_steps else None)
+
+    # ------------------------------------------------------ paral-config
+
+    def _batch_divisor(self) -> int:
+        """A tuned batch size must divide the data-parallel axis product
+        (batch-dim sharding) and the pipeline microbatch count."""
+        import math
+
+        mesh = self.res.mesh
+        div = 1
+        for ax in ("dp", "fsdp"):
+            div *= mesh.shape.get(ax, 1)
+        micro = getattr(self.res.model, "num_microbatches", 1)
+        return div * micro // math.gcd(div, micro)
+
+    def _apply_tuned_config(self, cfg: Dict) -> None:
+        """Apply a master-pushed ParallelConfig between steps.
+
+        Parity: reference elastic/dataloader.py:97-133 (batch size) +
+        paral_config_tuner ckpt cadence.  Mesh-shape changes need a restart
+        and are only logged here (the agent's restart path re-plans)."""
+        bs = int(cfg.get("dataloader_batch_size") or 0)
+        if bs > 0 and hasattr(self.train_data, "update_batch_size") and \
+                bs != getattr(self.train_data, "batch_size", bs):
+            div = self._batch_divisor()
+            if bs % div:
+                logger.warning(
+                    "ignoring tuned batch size %d: not divisible by %d "
+                    "(data-axis sharding x pipeline microbatches)", bs, div)
+            else:
+                self.train_data.update_batch_size(bs)
+        ckpt_every = int(cfg.get("ckpt_interval_steps") or 0)
+        if ckpt_every > 0 and ckpt_every != self.args.save_steps:
+            logger.info("ckpt cadence %d -> %d steps",
+                        self.args.save_steps, ckpt_every)
+            self.args.save_steps = ckpt_every
+        if cfg.get("mesh_shape"):
+            logger.info("master proposes mesh %s (applies on next restart)",
+                        cfg["mesh_shape"])
+
     # ------------------------------------------------------------- schedule
 
     def _make_schedule(self, optax):
@@ -156,9 +204,13 @@ class Trainer:
 
         last_loss = float("nan")
         t_log = time.time()
-        tokens_per_step = a.global_batch_size * a.seq_len
         try:
             for step in range(start_step, a.max_steps):
+                if self._tune_listener is not None and \
+                        step % a.tune_config_steps == 0:
+                    tuned = self._tune_listener.poll()
+                    if tuned:
+                        self._apply_tuned_config(tuned)
                 batch = self.res.place_batch(
                     dict(self._batch_at(self.train_data, step)))
                 with self.profiler.step(step):
@@ -168,6 +220,9 @@ class Trainer:
                     last_loss = float(metrics["loss"])
                     dt = time.time() - t_log
                     t_log = time.time()
+                    # re-read the live batch size: the master may retune it
+                    tokens_per_step = a.seq_len * getattr(
+                        self.train_data, "batch_size", a.global_batch_size)
                     tps = a.logging_steps * tokens_per_step / max(dt, 1e-9)
                     logger.info("step %d loss=%.4f tokens/s=%.0f",
                                 step + 1, last_loss, tps)

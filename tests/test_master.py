@@ -345,3 +345,19 @@ class TestParalConfigTuner:
         mc.cfg = msg.ParallelConfig(dataloader_batch_size=32)
         assert tuner.poll_once() is True
         assert read_paral_config(path)["dataloader_batch_size"] == 32
+
+    def test_listener_reports_changes_once(self, tmp_path):
+        import json
+
+        from dlrover_wuqiong_tpu.agent.config_tuner import (
+            ParalConfigListener,
+        )
+
+        path = tmp_path / "paral.json"
+        listener = ParalConfigListener(path=str(path))
+        assert listener.poll() is None            # no file yet
+        path.write_text(json.dumps({"dataloader_batch_size": 8}))
+        assert listener.poll()["dataloader_batch_size"] == 8
+        assert listener.poll() is None            # unchanged
+        path.write_text(json.dumps({"dataloader_batch_size": 16}))
+        assert listener.poll()["dataloader_batch_size"] == 16

@@ -96,3 +96,73 @@ class TestTrainer:
             sched = tr._make_schedule(optax)
             assert float(sched(0)) <= args.learning_rate
             assert np.isfinite(float(sched(9)))
+
+
+class TestTunedConfigLoop:
+    """The closed auto-tuning loop: master → agent ParalConfigTuner → file
+    → trainer ParalConfigListener → ElasticDataLoader/ckpt cadence.
+
+    Parity: reference trainer/torch/elastic/dataloader.py:97-133."""
+
+    def test_master_tunes_loader_mid_epoch(self, tmp_path):
+        from dlrover_wuqiong_tpu.agent.config_tuner import ParalConfigTuner
+        from dlrover_wuqiong_tpu.agent.master_client import MasterClient
+        from dlrover_wuqiong_tpu.common import messages as msg
+        from dlrover_wuqiong_tpu.data.elastic_dataset import (
+            ElasticDataLoader,
+            ElasticDistributedSampler,
+        )
+        from dlrover_wuqiong_tpu.master.master import JobMaster
+
+        master = JobMaster(min_nodes=1, max_nodes=1)
+        master.prepare()
+        try:
+            mc = MasterClient(master.addr, node_id=0)
+            tuner = ParalConfigTuner(
+                mc, config_path=str(tmp_path / "paral.json"))
+
+            vocab, seq = 512, 32
+            rng = np.random.default_rng(0)
+            table = rng.integers(0, vocab, (4096, seq + 1))
+
+            def read_sample(i):
+                return {"input_ids": table[i, :-1], "labels": table[i, 1:]}
+
+            batch_sizes = []
+
+            def collate(buf):
+                batch_sizes.append(len(buf))
+                return jax.tree.map(lambda *xs: np.stack(xs), *buf)
+
+            loader = ElasticDataLoader(
+                read_sample, batch_size=8,
+                sampler=ElasticDistributedSampler(dataset_size=4096),
+                collate=collate)
+
+            def push(step, metrics):
+                if step == 2:  # mid-training: the master retunes
+                    master.update_paral_config(msg.ParallelConfig(
+                        dataloader_batch_size=16, ckpt_interval_steps=50))
+                    tuner.poll_once()
+
+            args = TrainingArgs(
+                output_dir=str(tmp_path / "out"), max_steps=8,
+                global_batch_size=8, seq_len=seq, warmup_steps=1,
+                logging_steps=2, save_steps=0, save_on_exit=False,
+                tune_config_steps=1, strategy=[("fsdp", {})])
+            tr = Trainer(_model(), args, loader, callbacks=[push])
+            tr.train()
+            # the loader really emitted differently-sized batches mid-epoch
+            assert 8 in batch_sizes and 16 in batch_sizes, batch_sizes
+            assert batch_sizes[-1] == 16
+            # ckpt cadence followed the master's tuning
+            assert tr.args.save_steps == 50
+            tr.ckpt.close()
+        finally:
+            import os
+
+            from dlrover_wuqiong_tpu.common.constants import ConfigPath
+
+            os.environ.pop(ConfigPath.ENV_PARAL_CONFIG, None)
+            master.stop()
+            MasterClient.reset()
