@@ -26,7 +26,7 @@ import json
 import threading
 import time
 from collections import deque
-from typing import Deque, Dict, List, Optional
+from typing import Deque, Dict, List, Optional, Tuple
 
 from ..common import messages as msg
 from ..common.log import get_logger
@@ -59,6 +59,7 @@ class DiagnosisDataManager:
         self._node_steps: Dict[int, Deque] = {}
         self._resource: Dict[int, Deque] = {}
         self._stacks: Dict[int, str] = {}
+        self._op_profiles: Dict[int, Tuple[float, str]] = {}
 
     def forget_node(self, node_id: int):
         """Drop a departed node's series — stale timestamps otherwise keep
@@ -67,6 +68,7 @@ class DiagnosisDataManager:
             self._node_steps.pop(node_id, None)
             self._resource.pop(node_id, None)
             self._stacks.pop(node_id, None)
+            self._op_profiles.pop(node_id, None)
 
     def store_report(self, report: msg.DiagnosisReport):
         with self._lock:
@@ -86,6 +88,10 @@ class DiagnosisDataManager:
                     pass
             elif report.payload_type == "stack":
                 self._stacks[report.node_id] = report.content
+            elif report.payload_type == "op_profile":
+                # xpu_timer parity: worker-pushed top-slow-collective JSON
+                # (utils/xplane.py OpProfile.collective_evidence)
+                self._op_profiles[report.node_id] = (ts, report.content)
 
     def latest_step_time(self) -> Optional[float]:
         with self._lock:
@@ -108,6 +114,15 @@ class DiagnosisDataManager:
     def node_stack(self, node_id: int) -> str:
         with self._lock:
             return self._stacks.get(node_id, "")
+
+    def node_op_profile(self, node_id: int, max_age: float = 3600.0) -> str:
+        """Latest collective-latency evidence, unless stale — a fire-once
+        profile window must not be cited for a hang hours later."""
+        with self._lock:
+            ts, content = self._op_profiles.get(node_id, (0.0, ""))
+            if content and time.time() - ts > max_age:
+                return ""
+            return content
 
 
 # --------------------------------------------------------------- operators
@@ -165,10 +180,13 @@ class ResolveHangCauseOperator(InferenceOperator):
                     ((n, times[-1]) for n, times in node_steps.items()
                      if times), key=lambda kv: kv[1])
                 stack = data.node_stack(culprit)
+                ops = data.node_op_profile(culprit)
                 out.append(Inference(
                     "hang_culprit", node_id=culprit, is_conclusion=True,
                     detail=(p.detail + f"; node {culprit} stalled first"
-                            + ("; stack available" if stack else ""))))
+                            + ("; stack available" if stack else "")
+                            + (f"; slowest collectives: {ops}" if ops
+                               else ""))))
             else:
                 out.append(Inference("training_hang", is_conclusion=True,
                                      detail=p.detail))

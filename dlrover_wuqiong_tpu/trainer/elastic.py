@@ -76,6 +76,16 @@ class ElasticContext:
             except Exception:  # noqa: BLE001
                 logger.debug("step report failed", exc_info=True)
 
+    def report_op_profile(self, evidence: str):
+        """Push top-slow-collective evidence (utils/xplane.py) to the
+        master's diagnosis chain — xpu_timer parity for hang localization."""
+        if self.mc is None or not evidence:
+            return
+        try:
+            self.mc.report_diagnosis("op_profile", evidence)
+        except Exception:  # noqa: BLE001
+            logger.debug("op profile report failed", exc_info=True)
+
     def sharding_client(self, dataset_name: str, batch_size: int,
                         dataset_size: int, **kwargs):
         from ..agent.sharding_client import IndexShardingClient

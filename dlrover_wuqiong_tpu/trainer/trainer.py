@@ -107,11 +107,16 @@ class Trainer:
             end_step=args.profile_end_step)
 
         # master-tuned runtime config (batch size / ckpt cadence) — closes
-        # the loop master → agent ParalConfigTuner → file → trainer
+        # the loop master → agent ParalConfigTuner → file → trainer.
+        # Gated on the env path the agent's tuner exports: a standalone run
+        # must not pick up a dead job's file at the shared default path.
         from ..agent.config_tuner import ParalConfigListener
+        from ..common.constants import ConfigPath
 
-        self._tune_listener = (ParalConfigListener()
-                               if args.tune_config_steps else None)
+        self._tune_listener = (
+            ParalConfigListener()
+            if args.tune_config_steps and os.getenv(ConfigPath.ENV_PARAL_CONFIG)
+            else None)
 
     # ------------------------------------------------------ paral-config
 
@@ -213,9 +218,14 @@ class Trainer:
                         self._apply_tuned_config(tuned)
                 batch = self.res.place_batch(
                     dict(self._batch_at(self.train_data, step)))
+                prof_before = self.profiler.last_profile
                 with self.profiler.step(step):
                     self.state, metrics = self.res.train_step(self.state,
                                                               batch)
+                if self.profiler.last_profile is not prof_before:
+                    # a trace window just closed: surface slow collectives
+                    self.ctx.report_op_profile(
+                        self.profiler.last_profile.collective_evidence())
                 if a.logging_steps and (step + 1) % a.logging_steps == 0:
                     last_loss = float(metrics["loss"])
                     dt = time.time() - t_log

@@ -42,6 +42,7 @@ class StepProfiler:
         self.end_step = end_step
         self._tracing = False
         self._job = job_name
+        self.last_profile = None  # OpProfile of the latest closed window
         if registry is None:
             from ..master.metrics import get_registry
 
@@ -79,6 +80,36 @@ class StepProfiler:
             jax.profiler.stop_trace()
             self._tracing = False
             logger.info("jax.profiler trace stopped at step %d", step)
+            self._publish_op_profile()
+
+    def _publish_op_profile(self):
+        """xpu_timer parity: per-op-category latencies from the XPlane →
+        MetricRegistry (→ Prometheus) + diagnosis evidence."""
+        from .xplane import parse_trace_dir
+
+        try:
+            prof = parse_trace_dir(self.trace_dir)
+        except Exception:  # noqa: BLE001 — observability must not kill train
+            logger.warning("xplane parse failed", exc_info=True)
+            return
+        if prof is None:
+            return
+        self.last_profile = prof
+        for cat, sec in sorted(prof.categories.items()):
+            self._reg.gauge("dwt_op_category_seconds", sec,
+                            {"job": self._job, "category": cat},
+                            help="device time per op category in the last "
+                                 "trace window (xplane)")
+        for op in prof.top(k=10):
+            self._reg.gauge("dwt_op_seconds", op.total_s,
+                            {"job": self._job, "op": op.name,
+                             "category": op.category},
+                            help="device time of the hottest ops in the "
+                                 "last trace window (xplane)")
+        logger.info(
+            "op profile: %s",
+            " ".join(f"{c}={s * 1e3:.2f}ms"
+                     for c, s in sorted(prof.categories.items())))
 
     def close(self):
         if self._tracing:
@@ -86,6 +117,7 @@ class StepProfiler:
 
             jax.profiler.stop_trace()
             self._tracing = False
+            self._publish_op_profile()
 
 
 @contextlib.contextmanager
