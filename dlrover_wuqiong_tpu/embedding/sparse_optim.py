@@ -30,9 +30,24 @@ import jax
 import jax.numpy as jnp
 
 
+#: every supported kind; "group_<kind>" variants add the row-wise l21
+#: proximal shrink (reference Kv*Group* kernels, training_ops.cc:103-837)
+BASE_KINDS = ("adam", "adagrad", "ftrl", "sgd", "momentum", "lamb",
+              "adabelief", "amsgrad", "adahessian", "adadelta")
+
+
+def _base_kind(kind: str) -> str:
+    base = kind[6:] if kind.startswith("group_") else kind
+    if base not in BASE_KINDS:
+        raise ValueError(f"unknown sparse optimizer {kind!r}")
+    return base
+
+
 @dataclasses.dataclass(frozen=True)
 class SparseOptConfig:
-    kind: str = "adam"  # adam | group_adam | adagrad | ftrl | sgd
+    # adam | adagrad | ftrl | sgd | momentum | lamb | adabelief | amsgrad
+    # | adahessian | adadelta — each also as group_<kind> (row l21 shrink)
+    kind: str = "adam"
     lr: float = 1e-3
     beta1: float = 0.9
     beta2: float = 0.999
@@ -41,24 +56,41 @@ class SparseOptConfig:
     lr_power: float = -0.5
     l1: float = 0.0
     l2: float = 0.0
-    # group lasso (row-wise l21) for group_adam / ftrl
+    # group lasso (row-wise l21) — implied by a group_<kind> name
     l21: float = 0.0
+    # momentum / nesterov sgd
+    momentum: float = 0.9
+    nesterov: bool = False
+    # adadelta
+    rho: float = 0.95
+    # lamb
+    weight_decay: float = 0.0
+    # adahessian
+    hessian_power: float = 1.0
 
 
 def init_slot_state(cfg: SparseOptConfig, capacity: int, dim: int,
                     dtype=jnp.float32) -> Dict[str, Any]:
     """Optimizer state tables matching the value table layout."""
     zeros = lambda: jnp.zeros((capacity, dim), dtype)  # noqa: E731
-    if cfg.kind in ("adam", "group_adam"):
-        return {"m": zeros(), "v": zeros(),
-                "count": jnp.zeros((capacity, 1), jnp.int32)}
-    if cfg.kind == "adagrad":
+    counts = lambda: jnp.zeros((capacity, 1), jnp.int32)  # noqa: E731
+    base = _base_kind(cfg.kind)
+    if base in ("adam", "lamb", "adahessian"):
+        return {"m": zeros(), "v": zeros(), "count": counts()}
+    if base == "amsgrad":
+        return {"m": zeros(), "v": zeros(), "vmax": zeros(),
+                "count": counts()}
+    if base == "adabelief":
+        return {"m": zeros(), "s": zeros(), "count": counts()}
+    if base == "adagrad":
         return {"accum": zeros()}
-    if cfg.kind == "ftrl":
+    if base == "ftrl":
         return {"accum": zeros(), "z": zeros()}
-    if cfg.kind == "sgd":
-        return {}
-    raise ValueError(f"unknown sparse optimizer {cfg.kind!r}")
+    if base == "momentum":
+        return {"mom": zeros()}
+    if base == "adadelta":
+        return {"accum": zeros(), "accum_update": zeros()}
+    return {}  # sgd
 
 
 def dedup_grads(slots: jax.Array, grads: jax.Array, num_unique: int
@@ -79,52 +111,127 @@ def dedup_grads(slots: jax.Array, grads: jax.Array, num_unique: int
     return uniq, summed
 
 
+def _group_shrink(cfg: SparseOptConfig, new_rows: jax.Array,
+                  scale_by_lr: bool = True,
+                  force: bool = False) -> jax.Array:
+    """Row-wise group-lasso proximal step: shrink (or zero) whole rows.
+
+    Parity: the Group* kernel family's l21 term — prunes whole features.
+    Applies only to group_<kind> optimizers (plus ftrl, whose reference is
+    sparse_group_ftrl — it passes force=True), so a stray l21 value cannot
+    silently shrink a plain optimizer."""
+    if cfg.l21 <= 0 or not (force or cfg.kind.startswith("group_")):
+        return new_rows
+    norm = jnp.linalg.norm(new_rows, axis=-1, keepdims=True)
+    thresh = cfg.lr * cfg.l21 if scale_by_lr else cfg.l21
+    scale = jnp.maximum(0.0, 1.0 - thresh / jnp.maximum(norm, 1e-12))
+    return new_rows * scale
+
+
 @partial(jax.jit, static_argnames=("cfg",), donate_argnames=("table",
                                                              "state"))
 def apply_sparse_update(cfg: SparseOptConfig, table: jax.Array,
                         state: Dict[str, jax.Array], slots: jax.Array,
-                        grads: jax.Array
+                        grads: jax.Array,
+                        hessian: Optional[jax.Array] = None,
                         ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """One sparse step: update `table` rows at (deduped) `slots` by `grads`.
 
     slots: (n,) unique int32/64 row ids (dedup with `dedup_grads` first when
-    a batch can repeat ids).  grads: (n, dim).
+    a batch can repeat ids).  grads: (n, dim).  `hessian`: per-row diagonal
+    Hessian estimate for adahessian (Hutchinson probe); defaults to the
+    gradient (degenerating to adam-style second moments).
     """
     g = grads.astype(table.dtype)
     rows = table[slots]
+    base = _base_kind(cfg.kind)
 
-    if cfg.kind in ("adam", "group_adam"):
+    if base in ("adam", "lamb", "adahessian", "amsgrad"):
         m = state["m"][slots]
         v = state["v"][slots]
         cnt = state["count"][slots] + 1
         m = cfg.beta1 * m + (1 - cfg.beta1) * g
-        v = cfg.beta2 * v + (1 - cfg.beta2) * (g * g)
+        if base == "adahessian":
+            # second moments track the (Hutchinson) Hessian diagonal,
+            # optionally tempered by hessian_power (reference AdaHessian)
+            h = g if hessian is None else hessian.astype(table.dtype)
+            v = cfg.beta2 * v + (1 - cfg.beta2) * (h * h)
+        else:
+            v = cfg.beta2 * v + (1 - cfg.beta2) * (g * g)
         # per-row bias correction by the row's own step count — sparse rows
         # see far fewer updates than the global step (reference GroupAdam)
         c = cnt.astype(table.dtype)
         mhat = m / (1 - cfg.beta1 ** c)
         vhat = v / (1 - cfg.beta2 ** c)
-        new_rows = rows - cfg.lr * mhat / (jnp.sqrt(vhat) + cfg.eps)
-        if cfg.kind == "group_adam" and cfg.l21 > 0:
-            # row-wise group lasso proximal step: shrink whole rows
-            norm = jnp.linalg.norm(new_rows, axis=-1, keepdims=True)
-            scale = jnp.maximum(0.0, 1.0 - cfg.lr * cfg.l21 /
-                                jnp.maximum(norm, 1e-12))
-            new_rows = new_rows * scale
-        table = table.at[slots].set(new_rows)
         state = dict(state,
                      m=state["m"].at[slots].set(m),
                      v=state["v"].at[slots].set(v),
                      count=state["count"].at[slots].set(cnt))
-        return table, state
+        if base == "amsgrad":
+            vmax = jnp.maximum(state["vmax"][slots], vhat)
+            state["vmax"] = state["vmax"].at[slots].set(vmax)
+            update = mhat / (jnp.sqrt(vmax) + cfg.eps)
+        elif base == "adahessian":
+            denom = jnp.sqrt(vhat) ** cfg.hessian_power + cfg.eps
+            update = mhat / denom
+        else:
+            update = mhat / (jnp.sqrt(vhat) + cfg.eps)
+        if base == "lamb":
+            # row-adaptive trust ratio (the reference's layer-adaptive LAMB;
+            # an embedding row IS the natural layer/group here)
+            if cfg.weight_decay > 0:
+                update = update + cfg.weight_decay * rows
+            w_norm = jnp.linalg.norm(rows, axis=-1, keepdims=True)
+            u_norm = jnp.linalg.norm(update, axis=-1, keepdims=True)
+            ratio = jnp.where((w_norm > 0) & (u_norm > 0),
+                              w_norm / jnp.maximum(u_norm, 1e-12), 1.0)
+            update = ratio * update
+        new_rows = _group_shrink(cfg, rows - cfg.lr * update)
+        return table.at[slots].set(new_rows), state
 
-    if cfg.kind == "adagrad":
+    if base == "adabelief":
+        m = state["m"][slots]
+        s = state["s"][slots]
+        cnt = state["count"][slots] + 1
+        m = cfg.beta1 * m + (1 - cfg.beta1) * g
+        # the belief: variance of the gradient around its EMA prediction
+        s = cfg.beta2 * s + (1 - cfg.beta2) * jnp.square(g - m) + cfg.eps
+        c = cnt.astype(table.dtype)
+        mhat = m / (1 - cfg.beta1 ** c)
+        shat = s / (1 - cfg.beta2 ** c)
+        new_rows = rows - cfg.lr * mhat / (jnp.sqrt(shat) + cfg.eps)
+        new_rows = _group_shrink(cfg, new_rows)
+        return table.at[slots].set(new_rows), dict(
+            state, m=state["m"].at[slots].set(m),
+            s=state["s"].at[slots].set(s),
+            count=state["count"].at[slots].set(cnt))
+
+    if base == "momentum":
+        mom = cfg.momentum * state["mom"][slots] + g
+        update = g + cfg.momentum * mom if cfg.nesterov else mom
+        new_rows = _group_shrink(cfg, rows - cfg.lr * update)
+        return table.at[slots].set(new_rows), dict(
+            state, mom=state["mom"].at[slots].set(mom))
+
+    if base == "adadelta":
+        accum = cfg.rho * state["accum"][slots] + (1 - cfg.rho) * g * g
+        upd_acc = state["accum_update"][slots]
+        update = (jnp.sqrt(upd_acc + cfg.eps) /
+                  jnp.sqrt(accum + cfg.eps)) * g
+        upd_acc = cfg.rho * upd_acc + (1 - cfg.rho) * update * update
+        new_rows = _group_shrink(cfg, rows - cfg.lr * update)
+        return table.at[slots].set(new_rows), dict(
+            state, accum=state["accum"].at[slots].set(accum),
+            accum_update=state["accum_update"].at[slots].set(upd_acc))
+
+    if base == "adagrad":
         accum = state["accum"][slots] + g * g
         new_rows = rows - cfg.lr * g / (jnp.sqrt(accum) + cfg.eps)
+        new_rows = _group_shrink(cfg, new_rows)
         table = table.at[slots].set(new_rows)
         return table, dict(state, accum=state["accum"].at[slots].set(accum))
 
-    if cfg.kind == "ftrl":
+    if base == "ftrl":
         # sparse_group_ftrl (reference training/sparse_group_ftrl.py)
         accum = state["accum"][slots]
         z = state["z"][slots]
@@ -140,17 +247,14 @@ def apply_sparse_update(cfg: SparseOptConfig, table: jax.Array,
         # dedup padding — leave such rows untouched instead
         denom_safe = jnp.where(denom > 0, denom, 1.0)
         new_rows = jnp.where(denom > 0, base / denom_safe, rows)
-        if cfg.l21 > 0:  # group sparsity: zero rows under the l21 ball
-            norm = jnp.linalg.norm(new_rows, axis=-1, keepdims=True)
-            scale = jnp.maximum(0.0, 1.0 - cfg.l21 /
-                                jnp.maximum(norm, 1e-12))
-            new_rows = new_rows * scale
+        # group sparsity (sparse_group_ftrl): zero rows under the l21 ball
+        new_rows = _group_shrink(cfg, new_rows, scale_by_lr=False,
+                                 force=True)
         table = table.at[slots].set(new_rows)
         return table, dict(state,
                            accum=state["accum"].at[slots].set(new_accum),
                            z=state["z"].at[slots].set(z))
 
-    if cfg.kind == "sgd":
-        return table.at[slots].add(-cfg.lr * g), state
-
-    raise ValueError(f"unknown sparse optimizer {cfg.kind!r}")
+    # sgd (base kinds are validated by _base_kind above)
+    new_rows = _group_shrink(cfg, rows - cfg.lr * g)
+    return table.at[slots].set(new_rows), state

@@ -95,6 +95,10 @@ class StepProfiler:
         if prof is None:
             return
         self.last_profile = prof
+        # fresh window: drop last window's series (op names churn between
+        # windows; stale top-10 entries must not export forever)
+        self._reg.drop_gauge("dwt_op_seconds")
+        self._reg.drop_gauge("dwt_op_category_seconds")
         for cat, sec in sorted(prof.categories.items()):
             self._reg.gauge("dwt_op_category_seconds", sec,
                             {"job": self._job, "category": cat},

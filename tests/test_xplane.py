@@ -64,12 +64,15 @@ class TestWireParser:
         xplane_pb2 bindings (plane/line/event counts and durations)."""
         import importlib.util
 
+        tf_spec = importlib.util.find_spec("tensorflow")
         pb2_path = None
-        for base in ("/opt/venv/lib/python3.12/site-packages",):
-            hit = glob.glob(os.path.join(
-                base, "tensorflow/tsl/profiler/protobuf/xplane_pb2.py"))
-            if hit:
-                pb2_path = hit[0]
+        if tf_spec and tf_spec.submodule_search_locations:
+            for base in tf_spec.submodule_search_locations:
+                cand = os.path.join(base, "tsl", "profiler", "protobuf",
+                                    "xplane_pb2.py")
+                if os.path.exists(cand):
+                    pb2_path = cand
+                    break
         if pb2_path is None:
             pytest.skip("no generated xplane_pb2 available")
         spec = importlib.util.spec_from_file_location("xplane_pb2", pb2_path)
