@@ -61,11 +61,13 @@ class WorkerContext:
     """One launched training process + its world assignment."""
 
     def __init__(self, proc: subprocess.Popen, process_id: int,
-                 num_processes: int, restart_count: int):
+                 num_processes: int, restart_count: int,
+                 log_path: str = ""):
         self.proc = proc
         self.process_id = process_id
         self.num_processes = num_processes
         self.restart_count = restart_count
+        self.log_path = log_path  # captures stderr for error classification
 
 
 class RendezvousOutcome:
@@ -230,18 +232,64 @@ class ElasticAgent:
         stdout = None
         if self.config.log_dir:
             os.makedirs(self.config.log_dir, exist_ok=True)
-            stdout = open(os.path.join(
+            log_path = os.path.join(
                 self.config.log_dir,
-                f"worker_{self.node_rank}_r{self._restart_count}.log"), "ab")
+                f"worker_{self.node_rank}_r{self._restart_count}.log")
+            stdout = open(log_path, "ab")
+            stderr = subprocess.STDOUT
+        else:
+            # stderr always lands in a file: its tail (the traceback) is
+            # what the master's error catalogue classifies on failure
+            import tempfile
+
+            log_dir = os.path.join(tempfile.gettempdir(), "dwt-worker-logs")
+            os.makedirs(log_dir, exist_ok=True)
+            log_path = os.path.join(
+                log_dir, f"worker_{os.getpid()}_{self.node_rank}_"
+                         f"r{self._restart_count}.stderr")
+            stderr = open(log_path, "ab")
         proc = subprocess.Popen(
-            self.entrypoint, env=env, stdout=stdout,
-            stderr=subprocess.STDOUT if stdout else None,
+            self.entrypoint, env=env, stdout=stdout, stderr=stderr,
             start_new_session=True)
-        logger.info("launched worker pid=%d process_id=%d/%d coord=%s",
-                    proc.pid, outcome.process_id, outcome.num_processes,
-                    outcome.coordinator_addr)
+        # the child holds its own dups — close the parent copies, or the
+        # agent leaks one fd per restart over a long elastic job
+        for fh in (stdout, stderr):
+            if hasattr(fh, "close"):
+                fh.close()
+        self._prune_worker_logs(os.path.dirname(log_path), keep=5)
+        logger.info("launched worker pid=%d process_id=%d/%d coord=%s "
+                    "(log %s)", proc.pid, outcome.process_id,
+                    outcome.num_processes, outcome.coordinator_addr,
+                    log_path)
         return WorkerContext(proc, outcome.process_id,
-                             outcome.num_processes, self._restart_count)
+                             outcome.num_processes, self._restart_count,
+                             log_path=log_path)
+
+    def _prune_worker_logs(self, log_dir: str, keep: int = 5):
+        """Cap this agent's per-restart worker logs (oldest deleted)."""
+        try:
+            prefix = f"worker_{os.getpid()}_{self.node_rank}_"
+            mine = sorted(f for f in os.listdir(log_dir)
+                          if f.startswith(prefix))
+            for stale in mine[:-keep]:
+                os.unlink(os.path.join(log_dir, stale))
+        except OSError:
+            pass
+
+    def _worker_log_tail(self, max_bytes: int = 4000) -> str:
+        """Last bytes of the failed worker's captured output — the
+        traceback the master's error catalogue classifies."""
+        path = getattr(self._worker, "log_path", "")
+        if not path:
+            return ""
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - max_bytes))
+                return f.read().decode("utf-8", "replace")
+        except OSError:
+            return ""
 
     def _stop_worker(self, timeout: float = 30.0):
         if self._worker is None:
@@ -326,8 +374,22 @@ class ElasticAgent:
                     self._saver.save_shm_to_storage()
                 except Exception:  # noqa: BLE001
                     logger.exception("failure-save failed")
-            self.mc.report_failure(f"exit_code={exit_code}",
-                                   restart_count=self._restart_count)
+            # normalize Python's negative signal codes to shell style
+            # (-9 → 137) so the master's error catalogue can classify
+            # signal deaths (SIGKILL=OOM-kill, SIGTERM=preemption)
+            report_code = 128 - exit_code if exit_code < 0 else exit_code
+            error_data = f"exit_code={report_code}"
+            tail = self._worker_log_tail()
+            if tail:
+                error_data += "\n" + tail
+            resp = self.mc.report_failure(error_data,
+                                          restart_count=self._restart_count)
+            if resp is not None and not getattr(resp, "success", True):
+                # master's error catalogue says restarts can't fix this
+                # class (e.g. user-code error) — stop burning restarts
+                logger.error("master: %s — not restarting",
+                             getattr(resp, "reason", ""))
+                return exit_code
             self._restart_count += 1
             if self._restart_count > self.config.max_restarts:
                 logger.error("max restarts (%d) exhausted",

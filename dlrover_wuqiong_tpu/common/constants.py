@@ -72,6 +72,8 @@ class NodeExitReason:
     UNKNOWN_ERROR = "UnknownError"
 
     RELAUNCHABLE = {KILLED, OOM, HARDWARE_ERROR, HANG, UNKNOWN_ERROR}
+    KNOWN = {SUCCEEDED, KILLED, OOM, FATAL_ERROR, HARDWARE_ERROR, HANG,
+             UNKNOWN_ERROR}
 
 
 class JobExitReason:

@@ -60,6 +60,7 @@ class DiagnosisDataManager:
         self._resource: Dict[int, Deque] = {}
         self._stacks: Dict[int, str] = {}
         self._op_profiles: Dict[int, Tuple[float, str]] = {}
+        self._probes: Dict[int, Tuple[float, bool]] = {}
 
     def forget_node(self, node_id: int):
         """Drop a departed node's series — stale timestamps otherwise keep
@@ -69,6 +70,7 @@ class DiagnosisDataManager:
             self._resource.pop(node_id, None)
             self._stacks.pop(node_id, None)
             self._op_profiles.pop(node_id, None)
+            self._probes.pop(node_id, None)
 
     def store_report(self, report: msg.DiagnosisReport):
         with self._lock:
@@ -92,6 +94,15 @@ class DiagnosisDataManager:
                 # xpu_timer parity: worker-pushed top-slow-collective JSON
                 # (utils/xplane.py OpProfile.collective_evidence)
                 self._op_profiles[report.node_id] = (ts, report.content)
+            elif report.payload_type == "probe":
+                # device-queue liveness (diagnosis/probe.py DeviceProber)
+                try:
+                    res = json.loads(report.content)
+                    if isinstance(res, dict):
+                        self._probes[report.node_id] = (ts,
+                                                        bool(res.get("ok")))
+                except ValueError:
+                    pass
 
     def latest_step_time(self) -> Optional[float]:
         with self._lock:
@@ -114,6 +125,13 @@ class DiagnosisDataManager:
     def node_stack(self, node_id: int) -> str:
         with self._lock:
             return self._stacks.get(node_id, "")
+
+    def probe_status(self, max_age: float = 300.0) -> Dict[int, bool]:
+        """node → device-queue-idle? from recent DeviceProber reports."""
+        now = time.time()
+        with self._lock:
+            return {n: ok for n, (ts, ok) in self._probes.items()
+                    if now - ts <= max_age}
 
     def node_op_profile(self, node_id: int, max_age: float = 3600.0) -> str:
         """Latest collective-latency evidence, unless stale — a fire-once
@@ -174,23 +192,43 @@ class ResolveHangCauseOperator(InferenceOperator):
             if p.name not in self.refines:
                 continue
             node_steps = data.node_step_times()
-            if node_steps:
-                # the node whose last report is OLDEST stalled first
-                culprit, ts = min(
-                    ((n, times[-1]) for n, times in node_steps.items()
-                     if times), key=lambda kv: kv[1])
-                stack = data.node_stack(culprit)
-                ops = data.node_op_profile(culprit)
-                out.append(Inference(
-                    "hang_culprit", node_id=culprit, is_conclusion=True,
-                    detail=(p.detail + f"; node {culprit} stalled first"
-                            + ("; stack available" if stack else "")
-                            + (f"; slowest collectives: {ops}" if ops
-                               else ""))))
-            else:
+            if not node_steps:
                 out.append(Inference("training_hang", is_conclusion=True,
                                      detail=p.detail))
+                continue
+            probes = data.probe_status()
+            culprit, how = self._localize(node_steps, probes)
+            stack = data.node_stack(culprit)
+            ops = data.node_op_profile(culprit)
+            out.append(Inference(
+                "hang_culprit", node_id=culprit, is_conclusion=True,
+                detail=(p.detail + f"; node {culprit} {how}"
+                        + ("; stack available" if stack else "")
+                        + (f"; slowest collectives: {ops}" if ops
+                           else ""))))
         return out
+
+    @staticmethod
+    def _localize(node_steps, probes):
+        """Name the wedged rank from step cadence + device probes.
+
+        A rank whose device probe still completes (queue IDLE) while peers'
+        probes wedge never REACHED the collective — it is the cause, not a
+        victim (diagnosis/probe.py).  Without probe disagreement, fall back
+        to the oldest step report."""
+        if probes and any(probes.values()) and not all(probes.values()):
+            idle = [n for n, ok in probes.items() if ok]
+            # among idle-device nodes, the one with the oldest step stalled
+            # in host code first
+            cand = [(node_steps[n][-1], n) for n in idle
+                    if node_steps.get(n)]
+            if cand:
+                _, culprit = min(cand)
+                return culprit, ("never joined the collective (device "
+                                 "idle while peers wedged)")
+        culprit, _ = min(((n, t[-1]) for n, t in node_steps.items() if t),
+                         key=lambda kv: kv[1])
+        return culprit, "stalled first"
 
 
 class CheckStragglerOperator(InferenceOperator):

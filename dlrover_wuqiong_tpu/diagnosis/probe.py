@@ -1,0 +1,107 @@
+"""Worker-side device probe — collective-hang localization.
+
+Parity: reference `atorch/atorch/fault_tolerance/hanging_detector.py:86`
+(probe collectives + shared-store relaunch flags that localize which rank
+wedged).
+
+TPU redesign: a probe *collective* would enqueue behind the stuck
+collective and wedge with everyone else, telling us nothing.  Instead each
+worker periodically enqueues a tiny single-device op under a watchdog
+thread:
+
+- probe completes fast → this worker's device queue is IDLE.  If its step
+  reports are also stalled, it never REACHED the collective — it is the
+  likely culprit, stuck in host code / data loading while its peers wait.
+- probe never completes → the device is wedged inside the collective along
+  with its peers (a victim, not the cause).
+
+Results flow to the master as `report_diagnosis("probe", ...)` and the
+diagnosis chain combines them with step cadence to name the wedged rank
+(`manager.py ResolveHangCauseOperator`).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Callable, Optional
+
+from ..common.log import get_logger
+
+logger = get_logger("probe")
+
+
+def _default_probe_op() -> None:
+    """A tiny op on this process's first addressable device."""
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.local_devices()[0]
+    with jax.default_device(dev):
+        jnp.add(1.0, 1.0).block_until_ready()
+
+
+class DeviceProber:
+    """Background thread: probe the device queue, report liveness."""
+
+    def __init__(self, master_client=None, interval: float = 30.0,
+                 timeout: float = 10.0,
+                 probe_op: Optional[Callable[[], None]] = None):
+        self.mc = master_client
+        self.interval = interval
+        self.timeout = timeout
+        self._probe_op = probe_op or _default_probe_op
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._inflight: Optional[threading.Thread] = None
+        self.last_result: Optional[dict] = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="dwt-device-prober")
+        self._thread.start()
+
+    def probe_once(self) -> dict:
+        """One probe with watchdog; returns {ok, latency_s}."""
+        if self._inflight is not None and self._inflight.is_alive():
+            # the previous probe is still stuck behind the device queue —
+            # that IS the signal; don't stack more blocked threads
+            result = {"ok": False, "latency_s": self.timeout}
+        else:
+            t0 = time.monotonic()
+            done = threading.Event()
+
+            def _run():
+                try:
+                    self._probe_op()
+                    done.set()
+                except Exception:  # noqa: BLE001 — a dying device reads
+                    logger.debug("probe op failed", exc_info=True)  # as hung
+
+            t = threading.Thread(target=_run, daemon=True,
+                                 name="dwt-probe-op")
+            t.start()
+            ok = done.wait(self.timeout)
+            self._inflight = None if ok else t
+            result = {"ok": bool(ok),
+                      "latency_s": round(time.monotonic() - t0, 4)}
+        self.last_result = result
+        if self.mc is not None:
+            try:
+                self.mc.report_diagnosis("probe", json.dumps(result))
+            except Exception:  # noqa: BLE001
+                logger.debug("probe report failed", exc_info=True)
+        return result
+
+    def _loop(self):
+        while not self._stopped.wait(self.interval):
+            try:
+                self.probe_once()
+            except Exception:  # noqa: BLE001
+                logger.debug("probe loop error", exc_info=True)
+
+    def stop(self):
+        self._stopped.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

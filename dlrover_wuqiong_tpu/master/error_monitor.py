@@ -1,0 +1,124 @@
+"""Error-class catalogue → relaunch policy.
+
+Parity: reference `dlrover/python/master/monitor/error_monitor.py`
+(SimpleErrorMonitor / K8sJobErrorMonitor: classify process vs node errors,
+record per-restart error data, decide relaunch) and the exception levels in
+`common/constants.py` (TrainingExceptionLevel).
+
+TPU adaptation: the catalogue speaks XLA/TPU — RESOURCE_EXHAUSTED device
+OOM, libtpu/ICI hardware faults, coordinator/DEADLINE network failures —
+instead of CUDA ECC strings.  Classification lands in a proper
+`NodeExitReason` so the JobManager's relaunch decision table
+(`job_manager.py _should_relaunch`) acts on a class, not a raw message.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..common.constants import NodeExitReason
+from ..common.log import get_logger
+
+logger = get_logger("error_monitor")
+
+
+# (class name, NodeExitReason, relaunchable, compiled patterns) — first
+# match wins, checked top-to-bottom from most to least specific.
+_CATALOG: List[Tuple[str, str, bool, re.Pattern]] = [
+    ("device_oom", NodeExitReason.OOM, True, re.compile(
+        r"RESOURCE_EXHAUSTED|out of memory|hbm.*exceeded|"
+        r"allocat\w* .*fail\w* .*memory", re.I)),
+    ("host_oom", NodeExitReason.OOM, True, re.compile(
+        r"MemoryError|exit_code=137|oom[-_ ]?kill|Cannot allocate memory",
+        re.I)),
+    ("hardware", NodeExitReason.HARDWARE_ERROR, True, re.compile(
+        r"libtpu|tpu.*(unavailable|driver|halt)|ici\b|interconnect|"
+        r"DATA_LOSS|uncorrectable|INTERNAL:.*(device|chip)", re.I)),
+    ("network", NodeExitReason.KILLED, True, re.compile(
+        r"DEADLINE_EXCEEDED|UNAVAILABLE|connection (refused|reset)|"
+        r"coordinator|barrier timeout|socket", re.I)),
+    ("preempted", NodeExitReason.KILLED, True, re.compile(
+        r"preempt|evict|SIGTERM|exit_code=143", re.I)),
+    ("hang", NodeExitReason.HANG, True, re.compile(
+        r"\bhang\b|\bstall|watchdog", re.I)),
+    ("user_code", NodeExitReason.FATAL_ERROR, False, re.compile(
+        r"SyntaxError|ImportError|ModuleNotFoundError|NameError|"
+        r"AttributeError|TypeError|IndentationError", re.I)),
+]
+
+_DEFAULT = ("unknown", NodeExitReason.UNKNOWN_ERROR, True)
+
+
+def classify_error(error_data: str) -> Tuple[str, str, bool]:
+    """(error class, NodeExitReason, relaunchable) for an error payload."""
+    for name, reason, relaunch, pat in _CATALOG:
+        if pat.search(error_data or ""):
+            return name, reason, relaunch
+    return _DEFAULT
+
+
+class ErrorMonitor:
+    """Per-node error history + relaunch decisions from the catalogue.
+
+    Parity: reference SimpleErrorMonitor.process_error — called on each
+    NodeFailure report; dedupes repeated errors per restart and returns
+    whether the class allows relaunch.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # rank -> [(pod/node id, restart_count, class, error_data)]
+        self._history: Dict[int, List[Tuple[int, int, str, str]]] = {}
+
+    def process_error(self, rank: int, restart_count: int,
+                      error_data: str, level: str = "process",
+                      node_id: Optional[int] = None) -> Tuple[str, bool]:
+        """Record + classify; returns (NodeExitReason, relaunchable).
+
+        `rank` is the stable identity across relaunches; `node_id` the
+        current pod — the dedup key includes it so the same class failing
+        again on a REPLACEMENT pod (fresh restart_count=0) still appends
+        to the rank's history (that recurrence is exactly what
+        `repeated_class` must see)."""
+        cls, reason, relaunch = classify_error(error_data)
+        nid = node_id if node_id is not None else rank
+        with self._lock:
+            hist = self._history.setdefault(rank, [])
+            if not any(n == nid and rc == restart_count and c == cls
+                       for n, rc, c, _ in hist):
+                hist.append((nid, restart_count, cls,
+                             (error_data or "")[:2000]))
+                logger.error("rank %s (node %s) restart %d failed [%s → "
+                             "%s, relaunch=%s]: %s", rank, nid,
+                             restart_count, cls, reason, relaunch,
+                             (error_data or "")[:300])
+        if level == "node":
+            # a node-level fault (agent died, machine gone) always needs a
+            # replacement pod regardless of the message class
+            return (reason if reason != NodeExitReason.FATAL_ERROR
+                    else NodeExitReason.UNKNOWN_ERROR), True
+        return reason, relaunch
+
+    def error_class_history(self, rank: int) -> List[Tuple[int, str]]:
+        with self._lock:
+            return [(rc, cls) for _, rc, cls, _ in
+                    self._history.get(rank, [])]
+
+    def repeated_class(self, rank: int, min_repeats: int = 3
+                       ) -> Optional[str]:
+        """The error class seen >= min_repeats consecutive failures — a
+        signal that relaunching alone will not fix this rank.
+
+        "unknown" never qualifies: bare exit codes collapse unrelated
+        crashes into one class, and cutting relaunches early on that noise
+        would strand genuinely transient failures."""
+        with self._lock:
+            hist = self._history.get(rank, [])
+        if len(hist) < min_repeats:
+            return None
+        tail = [cls for _, _, cls, _ in hist[-min_repeats:]]
+        if len(set(tail)) == 1 and tail[0] != "unknown":
+            return tail[0]
+        return None

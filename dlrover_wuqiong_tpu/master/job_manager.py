@@ -22,6 +22,7 @@ from ..common.constants import (
 from ..common.global_context import get_context
 from ..common.log import get_logger
 from ..common.node import Node, NodeEvent, NodeStateFlow
+from .error_monitor import ErrorMonitor
 
 logger = get_logger("job_manager")
 
@@ -76,6 +77,7 @@ class JobManager:
         self._next_node_id = 0
         self._stopped = threading.Event()
         self._heartbeat_timeout = ctx.node_heartbeat_timeout
+        self.error_monitor = ErrorMonitor()
         self._relaunch_listeners: List[Callable[[Node, Node], None]] = []
 
     # ------------------------------------------------------------- registry
@@ -156,6 +158,15 @@ class JobManager:
             return
         node.update_status(new_status)
         node.exit_reason = event.node.exit_reason or node.exit_reason
+        if node.exit_reason and \
+                node.exit_reason not in NodeExitReason.KNOWN:
+            # scheduler watchers report raw strings ("exit_code=137",
+            # "actor_died") — run them through the error catalogue so the
+            # relaunch table acts on a class and the rank accrues history
+            reason, _ = self.error_monitor.process_error(
+                node.rank_index, node.relaunch_count, node.exit_reason,
+                node_id=node.id)
+            node.exit_reason = reason
         self._fire_callbacks(node, old_status, new_status)
         if NodeStateFlow.should_relaunch(old_status, new_status):
             if self._should_relaunch(node):
@@ -180,7 +191,8 @@ class JobManager:
                 logger.exception("node event callback error")
 
     def _should_relaunch(self, node: Node) -> bool:
-        """Parity: reference `_should_relaunch` dist_job_manager.py:561."""
+        """Parity: reference `_should_relaunch` dist_job_manager.py:561 +
+        the error-class catalogue (monitor/error_monitor.py)."""
         ctx = get_context()
         if node.is_released:
             return False
@@ -190,6 +202,15 @@ class JobManager:
         if node.exit_reason == NodeExitReason.OOM:
             # bump memory ask and retry (resource optimizer refines it)
             node.config_resource.memory_mb *= 1.5
+        # keyed by rank_index: node ids change across relaunches but the
+        # rank's error history is what reveals a persistent failure
+        repeated = self.error_monitor.repeated_class(node.rank_index)
+        if repeated is not None and not ctx.relaunch_always:
+            # the same error class on 3+ consecutive restarts: relaunching
+            # is not fixing it — stop burning restarts
+            logger.warning("node %s keeps failing with %r — not "
+                           "relaunching", node.id, repeated)
+            return False
         if node.relaunch_count >= node.max_relaunch_count:
             return False
         return True

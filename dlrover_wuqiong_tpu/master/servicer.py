@@ -162,15 +162,32 @@ class MasterServicer:
             return msg.OkResponse()
 
         if isinstance(payload, msg.NodeFailure):
+            live = m.job_manager.get_node(payload.node_id)
+            rank = live.rank_index if live is not None else payload.node_id
+            # error-class catalogue: raw error text → NodeExitReason that
+            # the relaunch decision table understands
+            reason, relaunchable = m.job_manager.error_monitor.process_error(
+                rank, payload.restart_count, payload.error_data,
+                payload.level, node_id=payload.node_id)
             node = Node("worker", payload.node_id)
             node.status = NodeStatus.FAILED
-            node.exit_reason = payload.error_data or "UnknownError"
+            node.exit_reason = reason
             m.job_manager.process_event(NodeEvent(NodeEventType.MODIFIED,
                                                   node))
             m.task_manager.recover_tasks(payload.node_id)
             for rdzv in m.rdzv_managers.values():
                 rdzv.remove_alive_node(payload.node_id)
-            return msg.OkResponse()
+            # tell the agent whether process restarts can fix this class —
+            # a user-code error restarts into the same crash every time,
+            # and a class repeating across restarts is equally unfixable
+            repeated = m.job_manager.error_monitor.repeated_class(rank)
+            if repeated is not None:
+                relaunchable = False
+                why = f"error class {repeated!r} repeats across restarts"
+            else:
+                why = f"error class not restartable ({reason})"
+            return msg.OkResponse(success=relaunchable,
+                                  reason="" if relaunchable else why)
 
         if isinstance(payload, msg.NodeEventReport):
             logger.info("node event from %s: %s %s", payload.node_id,

@@ -52,6 +52,8 @@ class TrainingArgs:
     save_on_exit: bool = True
     tune_config_steps: int = 25              # poll master's paral config
     # every k steps (0 = off); applies dataloader batch size + ckpt cadence
+    probe_interval: float = 30.0             # device-queue liveness probe
+    # cadence for hang localization (0 = off; active only under the agent)
 
 
 class Trainer:
@@ -117,6 +119,15 @@ class Trainer:
             ParalConfigListener()
             if args.tune_config_steps and os.getenv(ConfigPath.ENV_PARAL_CONFIG)
             else None)
+
+        # device-queue liveness probe → master hang localization
+        self._prober = None
+        if args.probe_interval > 0 and self.ctx.mc is not None:
+            from ..diagnosis.probe import DeviceProber
+
+            self._prober = DeviceProber(self.ctx.mc,
+                                        interval=args.probe_interval)
+            self._prober.start()
 
     # ------------------------------------------------------ paral-config
 
@@ -248,6 +259,8 @@ class Trainer:
                     logger.info("step %d eval_loss=%.4f", step + 1,
                                 eval_loss)
         finally:
+            if self._prober is not None:
+                self._prober.stop()
             if a.save_on_exit:
                 self._save(int(np.asarray(
                     jax.tree.leaves(self.state.step)[0])))

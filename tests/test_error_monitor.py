@@ -1,0 +1,254 @@
+"""Error-class catalogue + device-probe hang localization tests.
+
+Parity targets: reference master/monitor/error_monitor.py (classification →
+relaunch policy) and fault_tolerance/hanging_detector.py:86 (localizing the
+wedged rank).
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from dlrover_wuqiong_tpu.common import messages as msg
+from dlrover_wuqiong_tpu.common.constants import NodeExitReason
+from dlrover_wuqiong_tpu.common.util import is_oom_error
+from dlrover_wuqiong_tpu.diagnosis.manager import (
+    CheckTrainingHangOperator,
+    DiagnosisDataManager,
+    InferenceChain,
+    ResolveHangCauseOperator,
+)
+from dlrover_wuqiong_tpu.diagnosis.probe import DeviceProber
+from dlrover_wuqiong_tpu.master.error_monitor import (
+    ErrorMonitor,
+    classify_error,
+)
+
+
+class TestClassify:
+    @pytest.mark.parametrize("text,cls,reason,relaunch", [
+        ("XlaRuntimeError: RESOURCE_EXHAUSTED: Out of memory allocating",
+         "device_oom", NodeExitReason.OOM, True),
+        ("worker exit_code=137", "host_oom", NodeExitReason.OOM, True),
+        ("INTERNAL: libtpu.so initialization failed", "hardware",
+         NodeExitReason.HARDWARE_ERROR, True),
+        ("DEADLINE_EXCEEDED: barrier timeout waiting for coordinator",
+         "network", NodeExitReason.KILLED, True),
+        ("SIGTERM received, pod evicted", "preempted",
+         NodeExitReason.KILLED, True),
+        ("ModuleNotFoundError: No module named 'foo'", "user_code",
+         NodeExitReason.FATAL_ERROR, False),
+        ("TypeError: unsupported operand", "user_code",
+         NodeExitReason.FATAL_ERROR, False),
+        ("watchdog fired: training hang", "hang", NodeExitReason.HANG,
+         True),
+        ("something entirely else", "unknown",
+         NodeExitReason.UNKNOWN_ERROR, True),
+    ])
+    def test_catalog(self, text, cls, reason, relaunch):
+        got_cls, got_reason, got_relaunch = classify_error(text)
+        assert (got_cls, got_reason, got_relaunch) == (cls, reason,
+                                                       relaunch)
+
+    def test_node_level_always_gets_replacement(self):
+        em = ErrorMonitor()
+        reason, relaunch = em.process_error(
+            0, 0, "TypeError: agent crashed", level="node")
+        assert relaunch is True
+        assert reason != NodeExitReason.FATAL_ERROR
+
+    def test_repeated_class_detection(self):
+        em = ErrorMonitor()
+        for rc in range(3):
+            em.process_error(7, rc, "RESOURCE_EXHAUSTED: OOM")
+        assert em.repeated_class(7) == "device_oom"
+        em2 = ErrorMonitor()
+        em2.process_error(7, 0, "RESOURCE_EXHAUSTED")
+        em2.process_error(7, 1, "connection refused")
+        em2.process_error(7, 2, "RESOURCE_EXHAUSTED")
+        assert em2.repeated_class(7) is None
+
+    def test_dedupe_same_restart(self):
+        em = ErrorMonitor()
+        em.process_error(1, 0, "RESOURCE_EXHAUSTED")
+        em.process_error(1, 0, "RESOURCE_EXHAUSTED again")
+        assert len(em.error_class_history(1)) == 1
+
+    def test_replacement_pod_recurrence_accumulates(self):
+        """The same class failing on successive REPLACEMENT pods (fresh
+        restart_count=0 each time) must still build the rank's history —
+        that recurrence is what repeated_class exists to catch."""
+        em = ErrorMonitor()
+        for pod in (10, 11, 12):  # rank 0 relaunched as new pods
+            em.process_error(0, 0, "libtpu driver wedged", node_id=pod)
+        assert len(em.error_class_history(0)) == 3
+        assert em.repeated_class(0) == "hardware"
+
+    def test_unknown_class_never_triggers_cutoff(self):
+        em = ErrorMonitor()
+        for pod in (1, 2, 3):
+            em.process_error(0, 0, "exit_code=1", node_id=pod)
+        assert em.repeated_class(0) is None
+
+
+class TestIsOomError:
+    def test_narrowed_heuristic(self):
+        class XlaRuntimeError(Exception):
+            pass
+
+        assert is_oom_error(XlaRuntimeError("RESOURCE_EXHAUSTED: foo"))
+        assert is_oom_error(XlaRuntimeError("Out of memory while running"))
+        # host MemoryError / arbitrary "memory" strings are NOT device OOM
+        assert not is_oom_error(MemoryError("out of memory"))
+        assert not is_oom_error(ValueError("insufficient memory budget"))
+        assert not is_oom_error(XlaRuntimeError("INVALID_ARGUMENT: shape"))
+
+
+class TestRelaunchPolicy:
+    def test_user_code_error_not_relaunched_via_rpc(self):
+        """Full path: report_failure RPC → catalogue → no relaunch."""
+        from dlrover_wuqiong_tpu.agent.master_client import MasterClient
+        from dlrover_wuqiong_tpu.master.master import JobMaster
+
+        master = JobMaster(min_nodes=1, max_nodes=1)
+        master.prepare()
+        try:
+            c = MasterClient(master.addr, node_id=0)
+            c.register_node(0)
+            c.report_failure("ModuleNotFoundError: no module named 'x'",
+                             restart_count=0)
+            node = master.job_manager.get_node(0)
+            assert node.exit_reason == NodeExitReason.FATAL_ERROR
+            assert not node.relaunchable
+        finally:
+            master.stop()
+            MasterClient.reset()
+
+    def test_repeated_oom_stops_relaunching(self):
+        from dlrover_wuqiong_tpu.master.job_manager import LocalJobManager
+
+        jm = LocalJobManager(max_relaunch_count=10)
+        node = jm.register_node("worker", 0, rank_index=0)
+        node.exit_reason = NodeExitReason.OOM
+        for rc in range(3):
+            jm.error_monitor.process_error(0, rc, "RESOURCE_EXHAUSTED")
+        assert jm._should_relaunch(node) is False
+
+    def test_scheduler_raw_exit_reason_normalized(self):
+        """Watcher-observed failures carry raw strings; process_event must
+        classify them so the relaunch table and history work."""
+        from dlrover_wuqiong_tpu.common.constants import (
+            NodeEventType,
+            NodeStatus,
+        )
+        from dlrover_wuqiong_tpu.common.node import Node, NodeEvent
+        from dlrover_wuqiong_tpu.master.job_manager import LocalJobManager
+
+        jm = LocalJobManager(max_relaunch_count=3)
+        node = jm.register_node("worker", 0, rank_index=0)
+        node.update_status(NodeStatus.RUNNING)
+        node.config_resource.memory_mb = 1000
+        ev_node = Node("worker", 0)
+        ev_node.status = NodeStatus.FAILED
+        ev_node.exit_reason = "exit_code=137"  # scheduler's raw string
+        jm.process_event(NodeEvent(NodeEventType.MODIFIED, ev_node))
+        # classified to OOM → history recorded + the 1.5x memory escalation
+        # applied on relaunch (exit_reason itself is consumed by the local
+        # in-place relaunch)
+        assert jm.error_monitor.error_class_history(0) == [(0, "host_oom")]
+        assert node.config_resource.memory_mb == 1500
+
+    def test_single_oom_still_relaunches_with_bump(self):
+        from dlrover_wuqiong_tpu.master.job_manager import LocalJobManager
+
+        jm = LocalJobManager(max_relaunch_count=10)
+        node = jm.register_node("worker", 0, rank_index=0)
+        node.exit_reason = NodeExitReason.OOM
+        node.config_resource.memory_mb = 1000
+        jm.error_monitor.process_error(0, 0, "RESOURCE_EXHAUSTED")
+        assert jm._should_relaunch(node) is True
+        assert node.config_resource.memory_mb == 1500
+
+
+class TestDeviceProber:
+    def test_healthy_device_probes_ok(self):
+        reports = []
+
+        class FakeMC:
+            def report_diagnosis(self, payload_type, content):
+                reports.append((payload_type, json.loads(content)))
+
+        prober = DeviceProber(FakeMC(), timeout=30.0)
+        res = prober.probe_once()
+        assert res["ok"] is True
+        assert reports and reports[0][0] == "probe"
+        assert reports[0][1]["ok"] is True
+
+    def test_wedged_device_reports_blocked(self):
+        release = threading.Event()
+
+        def stuck_op():
+            release.wait(30)
+
+        prober = DeviceProber(None, timeout=0.2, probe_op=stuck_op)
+        res = prober.probe_once()
+        assert res["ok"] is False
+        # a second probe does not stack another blocked thread
+        res2 = prober.probe_once()
+        assert res2["ok"] is False
+        release.set()
+
+    def test_probe_failure_reads_as_hung(self):
+        def dying_op():
+            raise RuntimeError("device gone")
+
+        prober = DeviceProber(None, timeout=0.3, probe_op=dying_op)
+        assert prober.probe_once()["ok"] is False
+
+
+class TestHangLocalization:
+    def _hang_data(self, probes):
+        data = DiagnosisDataManager()
+        old = time.time() - 3600
+        # node 1's step report is NEWEST — oldest-step heuristic alone
+        # would blame node 0
+        data.store_report(msg.DiagnosisReport(
+            node_id=0, payload_type="step", content="5", timestamp=old))
+        data.store_report(msg.DiagnosisReport(
+            node_id=1, payload_type="step", content="6",
+            timestamp=old + 30))
+        for node, ok in probes.items():
+            data.store_report(msg.DiagnosisReport(
+                node_id=node, payload_type="probe",
+                content=json.dumps({"ok": ok}), timestamp=time.time()))
+        return data
+
+    def test_idle_device_overrides_oldest_step(self):
+        """Node 1 probes idle while node 0 is wedged → node 1 never joined
+        the collective and is named the culprit despite newer steps."""
+        data = self._hang_data({0: False, 1: True})
+        chain = InferenceChain([CheckTrainingHangOperator(timeout=60),
+                                ResolveHangCauseOperator()])
+        culprits = [c for c in chain.run(data) if c.name == "hang_culprit"]
+        assert culprits and culprits[0].node_id == 1
+        assert "never joined the collective" in culprits[0].detail
+
+    def test_all_wedged_falls_back_to_oldest_step(self):
+        data = self._hang_data({0: False, 1: False})
+        chain = InferenceChain([CheckTrainingHangOperator(timeout=60),
+                                ResolveHangCauseOperator()])
+        culprits = [c for c in chain.run(data) if c.name == "hang_culprit"]
+        assert culprits and culprits[0].node_id == 0
+        assert "stalled first" in culprits[0].detail
+
+    def test_stale_probes_ignored(self):
+        data = DiagnosisDataManager()
+        old = time.time() - 3600
+        data.store_report(msg.DiagnosisReport(
+            node_id=0, payload_type="step", content="5", timestamp=old))
+        data.store_report(msg.DiagnosisReport(
+            node_id=0, payload_type="probe",
+            content=json.dumps({"ok": True}), timestamp=old))
+        assert data.probe_status() == {}
