@@ -266,11 +266,15 @@ class ElasticAgent:
                              log_path=log_path)
 
     def _prune_worker_logs(self, log_dir: str, keep: int = 5):
-        """Cap this agent's per-restart worker logs (oldest deleted)."""
+        """Cap this agent's per-restart worker logs (oldest deleted).
+
+        Ordered by mtime, NOT filename — lexicographic sort would rank
+        r10 before r2 and delete the newest logs once restarts hit 10."""
         try:
             prefix = f"worker_{os.getpid()}_{self.node_rank}_"
-            mine = sorted(f for f in os.listdir(log_dir)
-                          if f.startswith(prefix))
+            mine = sorted(
+                (f for f in os.listdir(log_dir) if f.startswith(prefix)),
+                key=lambda f: os.path.getmtime(os.path.join(log_dir, f)))
             for stale in mine[:-keep]:
                 os.unlink(os.path.join(log_dir, stale))
         except OSError:
@@ -382,6 +386,9 @@ class ElasticAgent:
             tail = self._worker_log_tail()
             if tail:
                 error_data += "\n" + tail
+                # stderr is captured to a file now — echo the tail so local
+                # runs still show the traceback on the console
+                logger.error("worker stderr tail:\n%s", tail[-1500:])
             resp = self.mc.report_failure(error_data,
                                           restart_count=self._restart_count)
             if resp is not None and not getattr(resp, "success", True):

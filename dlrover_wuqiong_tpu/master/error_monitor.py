@@ -38,7 +38,8 @@ _CATALOG: List[Tuple[str, str, bool, re.Pattern]] = [
         r"DATA_LOSS|uncorrectable|INTERNAL:.*(device|chip)", re.I)),
     ("network", NodeExitReason.KILLED, True, re.compile(
         r"DEADLINE_EXCEEDED|UNAVAILABLE|connection (refused|reset)|"
-        r"coordinator|barrier timeout|socket", re.I)),
+        r"Connection(Reset|Refused|Aborted)Error|BrokenPipeError|"
+        r"TimeoutError|coordinator|barrier timeout|socket", re.I)),
     ("preempted", NodeExitReason.KILLED, True, re.compile(
         r"preempt|evict|SIGTERM|exit_code=143", re.I)),
     ("hang", NodeExitReason.HANG, True, re.compile(
@@ -50,11 +51,35 @@ _CATALOG: List[Tuple[str, str, bool, re.Pattern]] = [
 
 _DEFAULT = ("unknown", NodeExitReason.UNKNOWN_ERROR, True)
 
+# a traceback's final line names the exception — any *Error/*Exception not
+# claimed by a specific class above is user code that restarts cannot fix
+_FINAL_EXC = re.compile(r"^\w*(Error|Exception)\b")
+
+#: classes where recurrence is expected and relaunching is the right call —
+#: the repeated-class cutoff must never fire on these (preemption storms
+#: and coordinator blips are exactly what elasticity exists to survive)
+TRANSIENT_CLASSES = {"unknown", "preempted", "network"}
+
 
 def classify_error(error_data: str) -> Tuple[str, str, bool]:
-    """(error class, NodeExitReason, relaunchable) for an error payload."""
+    """(error class, NodeExitReason, relaunchable) for an error payload.
+
+    Three passes to keep 4KB traceback tails honest: (1) the catalogue
+    against the FINAL line (the exception itself — a TypeError raised
+    inside socket.py must not classify as "network" just because the frame
+    paths mention sockets), (2) a generic *Error/*Exception final line →
+    user_code, (3) the catalogue against the full text (multi-line XLA
+    statuses, bare exit codes)."""
+    text = (error_data or "").strip()
+    final = next((ln.strip() for ln in reversed(text.splitlines())
+                  if ln.strip()), "")
     for name, reason, relaunch, pat in _CATALOG:
-        if pat.search(error_data or ""):
+        if pat.search(final):
+            return name, reason, relaunch
+    if _FINAL_EXC.match(final):
+        return "user_code", NodeExitReason.FATAL_ERROR, False
+    for name, reason, relaunch, pat in _CATALOG:
+        if pat.search(text):
             return name, reason, relaunch
     return _DEFAULT
 
@@ -111,14 +136,14 @@ class ErrorMonitor:
         """The error class seen >= min_repeats consecutive failures — a
         signal that relaunching alone will not fix this rank.
 
-        "unknown" never qualifies: bare exit codes collapse unrelated
-        crashes into one class, and cutting relaunches early on that noise
-        would strand genuinely transient failures."""
+        TRANSIENT_CLASSES never qualify: bare exit codes ("unknown")
+        collapse unrelated crashes into one class, and preemption/network
+        recurrences are exactly what relaunching is FOR."""
         with self._lock:
             hist = self._history.get(rank, [])
         if len(hist) < min_repeats:
             return None
         tail = [cls for _, _, cls, _ in hist[-min_repeats:]]
-        if len(set(tail)) == 1 and tail[0] != "unknown":
+        if len(set(tail)) == 1 and tail[0] not in TRANSIENT_CLASSES:
             return tail[0]
         return None

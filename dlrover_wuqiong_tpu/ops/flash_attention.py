@@ -407,7 +407,7 @@ def _attention_reference(q, k, v, causal: bool, sm_scale: float):
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, causal: bool = True,
                     sm_scale: Optional[float] = None,
-                    block_q: int = 256, block_k: int = 512):
+                    block_q: int = 1024, block_k: int = 1024):
     """Multi-head attention, FA2-style.
 
     Args: q (b, h, sq, d); k, v (b, h, sk, d).  Returns (b, h, sq, d).
@@ -559,7 +559,7 @@ flash_attention.defvjp(_fa_fwd, _fa_bwd)
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention_with_lse(q, k, v, causal: bool = True,
                              sm_scale: Optional[float] = None,
-                             block_q: int = 256, block_k: int = 512):
+                             block_q: int = 1024, block_k: int = 1024):
     """Like `flash_attention` but also returns lse (b, h, sq) f32 — the
     building block for ring/blockwise attention where partial results over
     disjoint key sets merge by logsumexp weights.  Differentiable in both

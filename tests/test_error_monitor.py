@@ -52,6 +52,39 @@ class TestClassify:
         assert (got_cls, got_reason, got_relaunch) == (cls, reason,
                                                        relaunch)
 
+    def test_traceback_final_line_beats_frame_paths(self):
+        """A TypeError raised inside socket.py must classify as user_code,
+        not network — the exception line wins over frame paths."""
+        tb = ('exit_code=1\nTraceback (most recent call last):\n'
+              '  File "/usr/lib/python3.12/socket.py", line 10, in recv\n'
+              '    coordinator.connect()\n'
+              "TypeError: unsupported operand type(s)")
+        cls, reason, relaunch = classify_error(tb)
+        assert (cls, relaunch) == ("user_code", False)
+
+    def test_unlisted_exception_final_line_is_user_code(self):
+        cls, reason, relaunch = classify_error(
+            "Traceback ...\nZeroDivisionError: division by zero")
+        assert (cls, relaunch) == ("user_code", False)
+
+    def test_infra_exception_final_line_not_user_code(self):
+        cls, _, relaunch = classify_error(
+            "Traceback ...\nConnectionResetError: [Errno 104]")
+        assert (cls, relaunch) == ("network", True)
+
+    def test_multiline_xla_status_classifies_from_full_text(self):
+        cls, _, _ = classify_error(
+            "XlaRuntimeError: RESOURCE_EXHAUSTED: out of memory\n"
+            "Allocation breakdown:\n  buffer 1: 2.0GiB\n  Total: 15.1GiB")
+        assert cls == "device_oom"
+
+    def test_transient_classes_never_cut_relaunch(self):
+        em = ErrorMonitor()
+        for pod in (1, 2, 3):
+            em.process_error(0, 0, "SIGTERM received, pod evicted",
+                             node_id=pod)
+        assert em.repeated_class(0) is None  # preemption keeps relaunching
+
     def test_node_level_always_gets_replacement(self):
         em = ErrorMonitor()
         reason, relaunch = em.process_error(
