@@ -56,9 +56,16 @@ class KvEmbedding:
         import jax
         import jax.numpy as jnp
 
+        import threading
+
         self.dim = dim
         self.opt = optimizer or SparseOptConfig()
         self.min_freq = min_freq
+        # serializes table/state swaps (grow, apply_gradients) when the
+        # embedding is shared across threads — e.g. a shard server's RPC
+        # handlers racing the owner's own input-pipeline calls
+        # (embedding/partitioned.py)
+        self.lock = threading.RLock()
         self.init_scale = init_scale
         self.dtype = dtype or jnp.float32
         self.sharding = sharding

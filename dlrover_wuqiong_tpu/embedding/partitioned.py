@@ -56,15 +56,31 @@ class EmbeddingShardServer:
     emb_stats, emb_export_delta / emb_advance_epoch (incremental ckpt)."""
 
     def __init__(self, embedding: KvEmbedding, shard_id: int,
-                 num_shards: int, host: str = "127.0.0.1", port: int = 0):
+                 num_shards: int, host: str = "127.0.0.1", port: int = 0,
+                 advertise_host: Optional[str] = None):
+        """Bind `host` (use "0.0.0.0" to serve off-host) and advertise
+        `advertise_host` (the address peers dial — required when binding a
+        wildcard, since "0.0.0.0:port" is not dialable)."""
         self.embedding = embedding
         self.shard_id = shard_id
         self.num_shards = num_shards
-        # RpcServer threads one handler per connection; KvEmbedding's
-        # table/state swaps are not thread-safe — serialize all mutations
-        self._lock = threading.Lock()
+        # RpcServer threads one handler per connection; the embedding's own
+        # RLock also covers the owner's direct (co-located client) calls
+        self._lock = embedding.lock
+        # idempotence: at-least-once RPC retries must not re-apply
+        # non-idempotent ops.  Mutating-op responses are cached by exact
+        # (client, seq) — a replayed retry gets the cached answer instead
+        # of a second gradient application.  Read ops (gather/stats) are
+        # safe to re-execute (a gather replay at worst re-bumps frequency
+        # once) and their row payloads are too large to cache.
+        self._applied: Dict[str, Dict[int, Dict]] = {}
         self._server = RpcServer(self._handle, host=host, port=port)
-        self.addr = f"{host}:{self._server.port}"
+        if advertise_host is None:
+            if host in ("0.0.0.0", "::", ""):
+                raise ValueError("binding a wildcard host needs an "
+                                 "explicit advertise_host peers can dial")
+            advertise_host = host
+        self.addr = f"{advertise_host}:{self._server.port}"
 
     def start(self):
         self._server.start()
@@ -85,36 +101,53 @@ class EmbeddingShardServer:
         if not isinstance(payload, dict) or "op" not in payload:
             raise ValueError("embedding shard expects {'op': ...} payloads")
         op = payload["op"]
+        client, seq = payload.get("client"), payload.get("seq")
+        mutating = op in ("emb_grads", "emb_advance_epoch")
         with self._lock:
-            if op == "emb_gather":
-                ids = _unpack(payload["ids"]).astype(np.int64)
-                self._check_owned(ids)
-                slots = self.embedding.lookup_slots(
-                    ids, insert=payload.get("insert", True))
-                rows = np.asarray(self.embedding.gather(slots))
-                return {"rows": _pack(rows)}
-            if op == "emb_grads":
-                ids = _unpack(payload["ids"]).astype(np.int64)
-                self._check_owned(ids)
-                grads = _unpack(payload["grads"])
-                # train=True keeps the min_freq filter: an id the forward
-                # read as the null row must not train its real row here
-                slots = self.embedding.lookup_slots(ids, insert=False,
-                                                    train=True)
-                self.embedding.apply_gradients(slots, grads)
-                return {"ok": True}
-            if op == "emb_stats":
-                return {"vocab": len(self.embedding.store),
-                        "capacity": self.embedding.store.capacity,
-                        "shard_id": self.shard_id,
-                        "num_shards": self.num_shards}
-            if op == "emb_export_delta":
-                delta, epoch = self.embedding.export_delta()
-                return {"epoch": epoch,
-                        "delta": {k: _pack(np.asarray(v))
-                                  for k, v in delta.items()}}
-            if op == "emb_advance_epoch":
-                return {"epoch": self.embedding.store.advance_epoch()}
+            if mutating and client is not None and seq is not None:
+                cache = self._applied.setdefault(client, {})
+                if seq in cache:
+                    return cache[seq]  # retry replay — do not re-apply
+                resp = self._execute(op, payload)
+                cache[seq] = resp
+                while len(cache) > 32:  # bound per-client memory
+                    cache.pop(min(cache))
+                return resp
+            return self._execute(op, payload)
+
+    def _execute(self, op, payload):
+        if op == "emb_gather":
+            # ids arrive WITH duplicates: each occurrence must count one
+            # frequency sighting, exactly as a direct KvEmbedding lookup
+            # would (min_freq admission parity)
+            ids = _unpack(payload["ids"]).astype(np.int64)
+            self._check_owned(ids)
+            slots = self.embedding.lookup_slots(
+                ids, insert=payload.get("insert", True))
+            rows = np.asarray(self.embedding.gather(slots))
+            return {"rows": _pack(rows)}
+        if op == "emb_grads":
+            ids = _unpack(payload["ids"]).astype(np.int64)
+            self._check_owned(ids)
+            grads = _unpack(payload["grads"])
+            # train=True keeps the min_freq filter: an id the forward
+            # read as the null row must not train its real row here
+            slots = self.embedding.lookup_slots(ids, insert=False,
+                                                train=True)
+            self.embedding.apply_gradients(slots, grads)
+            return {"ok": True}
+        if op == "emb_stats":
+            return {"vocab": len(self.embedding.store),
+                    "capacity": self.embedding.store.capacity,
+                    "shard_id": self.shard_id,
+                    "num_shards": self.num_shards}
+        if op == "emb_export_delta":
+            delta, epoch = self.embedding.export_delta()
+            return {"epoch": epoch,
+                    "delta": {k: _pack(np.asarray(v))
+                              for k, v in delta.items()}}
+        if op == "emb_advance_epoch":
+            return {"epoch": self.embedding.store.advance_epoch()}
         raise ValueError(f"unknown embedding op {op!r}")
 
 
@@ -128,10 +161,17 @@ class PartitionedKvEmbedding:
     def __init__(self, dim: int, shard_addrs: List[str],
                  local: Optional[Tuple[int, KvEmbedding]] = None,
                  timeout: float = 60.0):
+        import uuid
+
         self.dim = dim
         self.num_shards = len(shard_addrs)
         self._local_id = local[0] if local else -1
         self._local_emb = local[1] if local else None
+        # idempotence tag: servers replay cached responses for retried seqs
+        # instead of re-applying non-idempotent ops (grads, freq bumps)
+        self._client_id = uuid.uuid4().hex
+        self._seq = 0
+        self._seq_lock = threading.Lock()
         self._clients: Dict[int, RpcClient] = {
             w: RpcClient(addr, timeout=timeout)
             for w, addr in enumerate(shard_addrs) if w != self._local_id
@@ -146,50 +186,61 @@ class PartitionedKvEmbedding:
     def owners(self, ids: np.ndarray) -> np.ndarray:
         return np.abs(ids) % self.num_shards
 
-    def _split(self, ids: np.ndarray):
-        """ids → {owner: (unique owner ids, inverse positions)}."""
+    def _next_seq(self) -> int:
+        with self._seq_lock:
+            self._seq += 1
+            return self._seq
+
+    def _tagged(self, payload: Dict) -> Dict:
+        payload["client"] = self._client_id
+        payload["seq"] = self._next_seq()
+        return payload
+
+    def _masks(self, ids: np.ndarray):
         owners = self.owners(ids)
-        out = {}
-        for w in range(self.num_shards):
-            mask = owners == w
-            if not mask.any():
-                continue
-            uniq, inv = np.unique(ids[mask], return_inverse=True)
-            out[w] = (mask, uniq, inv)
-        return out
+        return {w: owners == w for w in range(self.num_shards)
+                if (owners == w).any()}
 
     def gather(self, ids: np.ndarray, insert: bool = True) -> np.ndarray:
-        """(n,) int64 ids → (n, dim) float rows, assembled in input order."""
+        """(n,) int64 ids → (n, dim) float rows, assembled in input order.
+
+        Ids go to owners WITH duplicates so per-occurrence frequency
+        counting (min_freq admission) matches the single-host path."""
         ids = np.ascontiguousarray(ids, np.int64).ravel()
         rows = np.zeros((ids.shape[0], self.dim), np.float32)
-        split = self._split(ids)
+        masks = self._masks(ids)
         futures = {}
-        for w, (mask, uniq, inv) in split.items():
+        for w, mask in masks.items():
             if w != self._local_id:
                 futures[w] = self._pool.submit(
                     self._clients[w].report,
-                    {"op": "emb_gather", "ids": _pack(uniq),
-                     "insert": insert})
-        for w, (mask, uniq, inv) in split.items():
+                    self._tagged({"op": "emb_gather",
+                                  "ids": _pack(ids[mask]),
+                                  "insert": insert}))
+        for w, mask in masks.items():
             if w == self._local_id:
-                slots = self._local_emb.lookup_slots(uniq, insert=insert)
-                shard_rows = np.asarray(self._local_emb.gather(slots),
-                                        np.float32)
+                with self._local_emb.lock:
+                    slots = self._local_emb.lookup_slots(ids[mask],
+                                                         insert=insert)
+                    shard_rows = np.asarray(self._local_emb.gather(slots),
+                                            np.float32)
             else:
                 shard_rows = _unpack(
                     futures[w].result()["rows"]).astype(np.float32)
-            rows[mask] = shard_rows[inv]
+            rows[mask] = shard_rows
         return rows
 
     def apply_gradients(self, ids: np.ndarray, grads: np.ndarray):
         """Push d(loss)/d(rows) back to the owners (duplicates pre-summed
-        host-side so each unique id updates exactly once)."""
+        host-side so each unique id updates exactly once — the same
+        semantics as KvEmbedding.apply_gradients' internal dedup)."""
         ids = np.ascontiguousarray(ids, np.int64).ravel()
         grads = np.asarray(grads, np.float32).reshape(ids.shape[0],
                                                       self.dim)
         futures = []
         local = None
-        for w, (mask, uniq, inv) in self._split(ids).items():
+        for w, mask in self._masks(ids).items():
+            uniq, inv = np.unique(ids[mask], return_inverse=True)
             summed = np.zeros((uniq.shape[0], self.dim), np.float32)
             np.add.at(summed, inv, grads[mask])
             if w == self._local_id:
@@ -197,15 +248,16 @@ class PartitionedKvEmbedding:
             else:
                 futures.append(self._pool.submit(
                     self._clients[w].report,
-                    {"op": "emb_grads", "ids": _pack(uniq),
-                     "grads": _pack(summed)}))
+                    self._tagged({"op": "emb_grads", "ids": _pack(uniq),
+                                  "grads": _pack(summed)})))
         if local is not None:
             uniq, summed = local
-            # train=True: the min_freq filter routes under-threshold ids to
-            # the null row (zero-grad) exactly as the forward gather did
-            slots = self._local_emb.lookup_slots(uniq, insert=False,
-                                                 train=True)
-            self._local_emb.apply_gradients(slots, summed)
+            with self._local_emb.lock:
+                # train=True: the min_freq filter routes under-threshold
+                # ids to the null row (zero-grad) as the forward did
+                slots = self._local_emb.lookup_slots(uniq, insert=False,
+                                                     train=True)
+                self._local_emb.apply_gradients(slots, summed)
         for f in futures:
             f.result()
 
