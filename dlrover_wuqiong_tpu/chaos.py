@@ -1,0 +1,295 @@
+"""Scripted chaos scenarios with goodput-style recovery invariants.
+
+Parity: reference `docs/tech_report/fault_tolerance_exps.md:27-80` — the
+chaosblade experiments (pod delete / CPU-stressed straggler / network
+break / process corruption) run against a live job, checking that training
+restores and the damaged node is excluded.
+
+Here each scenario is a callable returning an invariant report (dict), so
+it is equally a CI test body (tests/test_chaos.py) and an operator tool:
+
+    python -m dlrover_wuqiong_tpu.chaos pod-kill
+    python -m dlrover_wuqiong_tpu.chaos straggler
+    python -m dlrover_wuqiong_tpu.chaos network-partition
+
+pod-kill drives the REAL stack — `run` CLI → master → agent → worker with
+flash checkpoints — and hard-SIGKILLs the worker process group externally
+mid-save-window.  The other two exercise the master's detection machinery
+directly (fake platform backend), mirroring how the reference report reads
+its k8s experiments.
+
+The pod-kill worker deliberately parallels (but is distinct from)
+tests/test_elastic_e2e.py's crash worker: that one injects an IN-PROCESS
+fault (`os._exit` at a fixed step, deterministic), this one takes an
+EXTERNAL asynchronous SIGKILL — the chaosblade `kubectl delete pod`
+equivalent, which can land mid-checkpoint-write and therefore also proves
+the torn-state invariant.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Dict
+
+from .common.log import get_logger
+
+logger = get_logger("chaos")
+
+
+# ------------------------------------------------------------------ pod kill
+
+
+_POD_KILL_WORKER = r"""
+import os, sys, time
+import numpy as np
+
+from dlrover_wuqiong_tpu.trainer.elastic import init_elastic
+from dlrover_wuqiong_tpu.checkpoint.checkpointer import (
+    FlashCheckpointer, StorageType)
+
+ckpt_dir, marker_dir, total_steps = sys.argv[1], sys.argv[2], int(sys.argv[3])
+ctx = init_elastic()
+restart = ctx.world.restart_count
+ckpt = FlashCheckpointer(ckpt_dir, job_name=os.environ["DWT_JOB_NAME"])
+template = {"w": np.zeros((64, 64), np.float32),
+            "step": np.zeros((), np.int64)}
+state = ckpt.load_checkpoint(template)
+start = int(state["step"]) + 1 if state is not None else 0
+with open(os.path.join(marker_dir, f"start_r{restart}"), "w") as f:
+    f.write(str(start))
+with open(os.path.join(marker_dir, f"pid_r{restart}"), "w") as f:
+    f.write(str(os.getpid()))
+step = start - 1  # loop may be empty when the kill landed after the
+                  # final checkpoint committed
+for step in range(start, total_steps):
+    w = np.full((64, 64), float(step), np.float32)
+    ckpt.save_checkpoint(step, {"w": w, "step": np.int64(step)},
+                         storage_type=StorageType.DISK)
+    ctx.report_step(step)
+    with open(os.path.join(marker_dir, "progress"), "w") as f:
+        f.write(str(step))
+    time.sleep(0.05)
+ok = ckpt.wait_latest_checkpoint(60)
+with open(os.path.join(marker_dir, "done"), "w") as f:
+    f.write(f"{ok} {step}")
+"""
+
+
+def pod_kill(kill_at_step: int = 8, total_steps: int = 20,
+             timeout: float = 240.0) -> Dict:
+    """External SIGKILL of the training process mid-save-window.
+
+    Invariants: the job completes after an automatic restart; the resumed
+    run starts at a checkpointed step (goodput: lost work is bounded by the
+    save cadence); the final checkpoint is complete and consistent (the
+    done-dir commit never exposes a torn state)."""
+    import numpy as np
+
+    from .checkpoint.checkpointer import FlashCheckpointer
+
+    work = tempfile.mkdtemp(prefix="dwt-chaos-podkill-")
+    ckpt_dir = os.path.join(work, "ckpt")
+    marker = os.path.join(work, "markers")
+    os.makedirs(marker)
+    script = os.path.join(work, "worker.py")
+    with open(script, "w") as f:
+        f.write(_POD_KILL_WORKER)
+    job = f"chaos{os.getpid()}"
+    env = dict(
+        os.environ, DWT_JOB_NAME=job, JAX_PLATFORMS="cpu",
+        DWT_SOCKET_DIR=os.path.join(work, "sockets"),
+        PYTHONPATH=os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))) + os.pathsep +
+        os.environ.get("PYTHONPATH", ""))
+    cli = subprocess.Popen(
+        [sys.executable, "-m", "dlrover_wuqiong_tpu.run", "--standalone",
+         "--nproc_per_node=1", "--max_restarts=2", script, ckpt_dir,
+         marker, str(total_steps)],
+        env=env, cwd=work, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+
+    deadline = time.time() + timeout
+    killed_pid = None
+    killed_at = -1  # the step actually OBSERVED when the kill landed —
+    # polling can overshoot kill_at_step on a loaded host, so invariants
+    # bound against this, not the request
+    progress = os.path.join(marker, "progress")
+    while time.time() < deadline and killed_pid is None:
+        try:
+            seen = int(open(progress).read())
+            if seen >= kill_at_step:
+                killed_pid = int(open(os.path.join(marker, "pid_r0"))
+                                 .read())
+                os.kill(killed_pid, signal.SIGKILL)  # the chaosblade moment
+                killed_at = seen
+                logger.info("pod-kill: SIGKILL worker pid=%d at step %d",
+                            killed_pid, seen)
+        except (OSError, ValueError):
+            pass
+        time.sleep(0.05)
+    try:
+        out, _ = cli.communicate(timeout=max(5.0, deadline - time.time()))
+    except subprocess.TimeoutExpired:
+        cli.kill()
+        out, _ = cli.communicate()
+
+    report: Dict = {"scenario": "pod-kill", "killed_pid": killed_pid,
+                    "killed_at_step": killed_at, "cli_rc": cli.returncode}
+    report["completed"] = os.path.exists(os.path.join(marker, "done"))
+    report["restarts"] = sum(
+        1 for f in os.listdir(marker) if f.startswith("start_r")) - 1
+    resume_file = os.path.join(marker, "start_r1")
+    report["resume_step"] = (int(open(resume_file).read())
+                             if os.path.exists(resume_file) else -1)
+    # torn-checkpoint check: the committed latest must load completely and
+    # carry self-consistent contents
+    ck = FlashCheckpointer(ckpt_dir, job_name=f"{job}-verify")
+    state = ck.load_checkpoint({"w": np.zeros((64, 64), np.float32),
+                                "step": np.zeros((), np.int64)})
+    ck.close()
+    report["ckpt_intact"] = bool(
+        state is not None
+        and int(state["step"]) == total_steps - 1
+        and np.all(np.asarray(state["w"]) == float(int(state["step"]))))
+    # goodput: steps not lost to the fault / total useful steps
+    if report["resume_step"] >= 0 and killed_at >= 0:
+        lost = max(0, killed_at - report["resume_step"]) + 1
+        report["goodput"] = round(1.0 - lost / total_steps, 3)
+    report["ok"] = bool(
+        report["completed"] and report["restarts"] == 1
+        and 0 < report["resume_step"] <= killed_at + 1
+        and report["ckpt_intact"] and cli.returncode == 0)
+    if report["ok"]:
+        import shutil
+
+        shutil.rmtree(work, ignore_errors=True)
+    else:
+        report["cli_tail"] = out[-2000:]
+        report["workdir"] = work  # kept for debugging
+    return report
+
+
+# ----------------------------------------------------------------- straggler
+
+
+def straggler(n_nodes: int = 4, slow_node: int = 3,
+              slow_factor: float = 5.0) -> Dict:
+    """A CPU-stressed node steps far slower than its peers.
+
+    Mirrors the report's chaosblade CPU-load experiment: the network-check
+    sweep must name the straggler (so `--exclude-straggler` can drop it)
+    and the diagnosis chain must flag it from runtime step cadence too."""
+    from .common import messages as msg
+    from .diagnosis.manager import (
+        CheckStragglerOperator,
+        DiagnosisDataManager,
+        InferenceChain,
+    )
+    from .master.rendezvous import NetworkCheckRendezvousManager
+
+    # 1) pre-flight: pairwise network-check sweep
+    rdzv = NetworkCheckRendezvousManager()
+    rdzv.update_rdzv_params(n_nodes, n_nodes, waiting_timeout=0.0)
+    for nid in range(n_nodes):
+        rdzv.join_rendezvous(nid, nid, 1)
+    for nid in range(n_nodes):
+        elapsed = slow_factor if nid == slow_node else 1.0
+        rdzv.report_network_check_result(nid, True, elapsed)
+    stragglers, _ = rdzv.get_straggler(threshold=2.0)
+
+    # 2) runtime: step cadence diagnosis
+    data = DiagnosisDataManager()
+    now = time.time()
+    for nid in range(n_nodes):
+        period = 1.0 * (slow_factor if nid == slow_node else 1.0)
+        for k in range(8):
+            data.store_report(msg.DiagnosisReport(
+                node_id=nid, payload_type="step", content=str(k),
+                timestamp=now - (8 - k) * period))
+    chain = InferenceChain([CheckStragglerOperator(ratio=3.0,
+                                                   min_reports=6)])
+    flagged = [c.node_id for c in chain.run(data)
+               if c.name == "straggler"]
+
+    report = {"scenario": "straggler", "expected": slow_node,
+              "network_check_stragglers": stragglers,
+              "runtime_stragglers": flagged}
+    report["ok"] = (stragglers == [slow_node] and flagged == [slow_node])
+    return report
+
+
+# --------------------------------------------------------- network partition
+
+
+def network_partition(heartbeat_timeout: float = 1.5,
+                      wait: float = 3.0) -> Dict:
+    """A node's control-plane link drops: heartbeats stop arriving.
+
+    The master's heartbeat monitor must declare the node dead and relaunch
+    it through the scaler (reference: network-break chaosblade experiment —
+    the pod is replaced even though the process may still be running)."""
+    from .common.constants import NodeEventType, NodeStatus
+    from .common.global_context import get_context
+    from .common.node import Node, NodeEvent
+    from .master.job_manager import LocalJobManager
+
+    ctx = get_context()
+    old_timeout = ctx.node_heartbeat_timeout
+    ctx.node_heartbeat_timeout = heartbeat_timeout
+    try:
+        jm = LocalJobManager(max_relaunch_count=3)
+        for nid in range(2):
+            node = jm.register_node("worker", nid, rank_index=nid)
+            node.update_status(NodeStatus.RUNNING)
+            node.heartbeat_time = time.time()
+        t0 = time.time()
+        relaunched = []
+        # node 1 goes silent; node 0 keeps beating — the master's dead-node
+        # sweep (master.py run loop) is replayed here
+        while time.time() - t0 < wait and not relaunched:
+            jm.get_node(0).heartbeat_time = time.time()
+            for n in jm.get_dead_nodes():
+                relaunched.append(n.id)
+                dead = Node(n.type, n.id, rank_index=n.rank_index)
+                dead.status = NodeStatus.FAILED
+                dead.exit_reason = "Hang"
+                jm.process_event(NodeEvent(NodeEventType.MODIFIED, dead))
+            time.sleep(0.1)
+        n1 = jm.get_node(1)
+        report = {"scenario": "network-partition",
+                  "dead_detected": relaunched,
+                  "node1_relaunch_count": n1.relaunch_count}
+        report["ok"] = (relaunched == [1] and n1.relaunch_count == 1)
+        return report
+    finally:
+        ctx.node_heartbeat_timeout = old_timeout
+
+
+SCENARIOS = {"pod-kill": pod_kill, "straggler": straggler,
+             "network-partition": network_partition}
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    names = argv or list(SCENARIOS)
+    ok = True
+    for name in names:
+        fn = SCENARIOS.get(name)
+        if fn is None:
+            print(f"unknown scenario {name!r}; have {list(SCENARIOS)}",
+                  file=sys.stderr)
+            return 2
+        report = fn()
+        print(json.dumps(report))
+        ok = ok and report.get("ok", False)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,40 @@
+"""Chaos scenarios as CI tests (docs/tech_report/fault_tolerance_exps.md
+parity: pod delete / straggler / network break with recovery invariants).
+"""
+
+import pytest
+
+from dlrover_wuqiong_tpu import chaos
+
+
+def test_pod_kill_recovers_with_goodput():
+    report = chaos.pod_kill()
+    assert report["ok"], report
+    assert report["restarts"] == 1
+    assert 0 < report["resume_step"] <= 9
+    assert report["ckpt_intact"]
+    assert report["goodput"] >= 0.8
+
+
+def test_straggler_is_localized():
+    report = chaos.straggler()
+    assert report["ok"], report
+    assert report["network_check_stragglers"] == [3]
+    assert report["runtime_stragglers"] == [3]
+
+
+def test_network_partition_relaunches_silent_node():
+    report = chaos.network_partition()
+    assert report["ok"], report
+    assert report["dead_detected"] == [1]
+
+
+def test_cli_runs_all(capsys):
+    rc = chaos.main(["straggler", "network-partition"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 2
+
+
+def test_cli_unknown_scenario():
+    assert chaos.main(["bogus"]) == 2
