@@ -1,26 +1,25 @@
 """Brain service: persist job metrics, serve optimization plans.
 
 Parity: reference `dlrover/go/brain/pkg/server` (gRPC `persist_metrics`/
-`optimize`/`get_job_metrics`), optimizer plugins under
-`pkg/optimizer/implementation/`, and the MySQL datastore
-(`pkg/datastore/implementation/utils/mysql.go`) — here an in-memory store
-with optional JSON snapshots (one service per cluster; durable metrics
-belong to the metrics stack, not the optimizer's hot path).
+`optimize`/`get_job_metrics`).  The storage and decision layers live in
+`plugins.py` — a datastore registry (memory / durable JSON file, parity
+`pkg/datastore/implementation`) and named optimize algorithms selected by
+job stage/event (parity `optalgorithm/optimize_job_worker_*.go`).
 """
 
 from __future__ import annotations
 
 import json
-import os
-import threading
-import time
-from typing import Dict, List, Optional
+from typing import Optional
 
 from ..common import messages as msg
 from ..common.comm import RpcServer
 from ..common.log import get_logger
-from ..common.node import NodeResource
-from ..master.resource_optimizer import LocalResourceOptimizer
+from .plugins import (
+    BrainOptimizer,
+    JsonFileDataStore,
+    MemoryDataStore,
+)
 
 logger = get_logger("brain")
 
@@ -29,15 +28,11 @@ class BrainService:
     """One per cluster; many job masters report usage and ask for plans."""
 
     def __init__(self, port: int = 0, snapshot_path: Optional[str] = None,
-                 **optimizer_kw):
-        self._lock = threading.Lock()
-        # per-job optimizer state + a fleet-wide one seeding new jobs
-        self._per_job: Dict[str, LocalResourceOptimizer] = {}
-        self._fleet = LocalResourceOptimizer(**optimizer_kw)
-        self._optimizer_kw = optimizer_kw
-        self._snapshot_path = snapshot_path
+                 store: Optional[MemoryDataStore] = None, **optimizer_kw):
+        self.store = store or (JsonFileDataStore(snapshot_path)
+                               if snapshot_path else MemoryDataStore())
+        self.optimizer = BrainOptimizer(self.store, **optimizer_kw)
         self._server = RpcServer(self._handle, port=port)
-        self._load_snapshot()
 
     @property
     def port(self) -> int:
@@ -52,92 +47,30 @@ class BrainService:
         logger.info("brain service on :%d", self.port)
 
     def stop(self):
-        # server first: no handler may mutate optimizers mid-snapshot
+        # server first: no handler may mutate the store mid-flush
         self._server.stop()
-        self._save_snapshot()
+        self.store.flush()
 
     # ------------------------------------------------------------- handlers
 
-    def _job_opt(self, job: str) -> LocalResourceOptimizer:
-        with self._lock:
-            opt = self._per_job.get(job)
-            if opt is None:
-                opt = LocalResourceOptimizer(**self._optimizer_kw)
-                self._per_job[job] = opt
-            return opt
-
     def _handle(self, verb: str, node_id: int, node_type: str, payload):
         if isinstance(payload, msg.BrainPersistMetrics):
-            opt = self._job_opt(payload.job_name)
-            usage = NodeResource(cpu=payload.cpu,
-                                 memory_mb=payload.memory_mb)
-            opt.report_usage(payload.node_type, usage)
-            self._fleet.report_usage(payload.node_type, usage)
+            self.optimizer.report(payload.job_name, payload.node_type,
+                                  payload.cpu, payload.memory_mb)
             return msg.OkResponse()
 
         if isinstance(payload, msg.BrainOptimizeRequest):
-            opt = self._job_opt(payload.job_name)
-            # cold jobs inherit the fleet prior (the "cluster" optimize
-            # mode's advantage over single-job)
-            source = opt if opt.stage(payload.node_type) != "init" \
-                else self._fleet
-            plan = source.plan_node_resource(payload.node_type)
+            plan, stage, algo = self.optimizer.optimize(
+                payload.job_name, payload.node_type,
+                event=getattr(payload, "event", ""))
             return msg.BrainOptimizeResponse(
-                cpu=plan.cpu, memory_mb=plan.memory_mb,
-                stage=source.stage(payload.node_type))
+                cpu=plan.cpu, memory_mb=plan.memory_mb, stage=stage,
+                algorithm=algo)
 
         if isinstance(payload, msg.BrainJobMetricsRequest):
-            opt = self._per_job.get(payload.job_name)
-            samples = []
-            if opt is not None:
-                with opt._lock:  # noqa: SLF001 — same package family
-                    samples = [
-                        {"cpu": s.cpu, "memory_mb": s.memory_mb}
-                        for s in opt._usage_samples.get(  # noqa: SLF001
-                            payload.node_type, [])[-50:]]
+            samples = self.store.samples(payload.job_name,
+                                         payload.node_type)[-50:]
             return msg.BrainJobMetricsResponse(
                 samples=json.dumps(samples))
 
         raise ValueError(f"unknown brain message {type(payload).__name__}")
-
-    # ------------------------------------------------------------- snapshot
-
-    def _save_snapshot(self):
-        if not self._snapshot_path:
-            return
-        try:
-            data = {}
-            with self._lock:
-                jobs = list(self._per_job.items())
-            for job, opt in jobs:
-                with opt._lock:  # noqa: SLF001 — same package family
-                    data[job] = {
-                        nt: [{"cpu": s.cpu, "memory_mb": s.memory_mb}
-                             for s in samples]
-                        for nt, samples in
-                        opt._usage_samples.items()  # noqa: SLF001
-                    }
-            tmp = self._snapshot_path + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(data, f)
-            os.replace(tmp, self._snapshot_path)
-        except (OSError, RuntimeError):
-            logger.exception("brain snapshot failed")
-
-    def _load_snapshot(self):
-        if not self._snapshot_path or not os.path.exists(
-                self._snapshot_path):
-            return
-        try:
-            with open(self._snapshot_path) as f:
-                data = json.load(f)
-            for job, by_type in data.items():
-                opt = self._job_opt(job)
-                for nt, samples in by_type.items():
-                    for s in samples:
-                        usage = NodeResource(cpu=s["cpu"],
-                                             memory_mb=s["memory_mb"])
-                        opt.report_usage(nt, usage)
-                        self._fleet.report_usage(nt, usage)
-        except (OSError, ValueError, KeyError):
-            logger.exception("brain snapshot load failed")

@@ -157,9 +157,10 @@ def pod_kill(kill_at_step: int = 8, total_steps: int = 20,
         state is not None
         and int(state["step"]) == total_steps - 1
         and np.all(np.asarray(state["w"]) == float(int(state["step"]))))
-    # goodput: steps not lost to the fault / total useful steps
+    # goodput: steps not lost to the fault / total useful steps (zero
+    # lost when the resume point is past the killed step)
     if report["resume_step"] >= 0 and killed_at >= 0:
-        lost = max(0, killed_at - report["resume_step"]) + 1
+        lost = max(0, killed_at - report["resume_step"] + 1)
         report["goodput"] = round(1.0 - lost / total_steps, 3)
     report["ok"] = bool(
         report["completed"] and report["restarts"] == 1

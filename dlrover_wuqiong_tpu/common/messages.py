@@ -302,6 +302,7 @@ class BrainOptimizeRequest:
 
     job_name: str = ""
     node_type: str = "worker"
+    event: str = ""  # "" | "oom" — selects the OOM-bump algorithm
 
 
 @message
@@ -309,6 +310,7 @@ class BrainOptimizeResponse:
     cpu: float = 0.0
     memory_mb: float = 0.0
     stage: str = ""
+    algorithm: str = ""  # which registered optalgorithm produced the plan
 
 
 @message

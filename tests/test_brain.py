@@ -89,3 +89,87 @@ class TestBrainResourceOptimizer:
         opt.report_usage("worker", NodeResource(cpu=1, memory_mb=600))
         plan = opt.plan_node_resource("worker")
         assert plan.memory_mb == 1200  # local phased plan
+
+
+class TestBrainPlugins:
+    """Datastore + named-algorithm plugin layer (plugins.py)."""
+
+    def test_algorithm_registry_names(self):
+        from dlrover_wuqiong_tpu.brain.plugins import algorithms
+
+        assert set(algorithms()) >= {
+            "optimize_job_worker_create_resource",
+            "optimize_job_worker_init_adjust_resource",
+            "optimize_job_worker_resource",
+            "optimize_job_worker_create_oom_resource"}
+
+    def test_oom_event_selects_bump_algorithm(self, brain):
+        c = BrainClient(brain.addr, "jobOOM")
+        for _ in range(3):
+            c.persist_metrics("worker", cpu=1.0, memory_mb=1000)
+        from dlrover_wuqiong_tpu.common import messages as msg
+
+        resp = c._client.get(msg.BrainOptimizeRequest(
+            job_name="jobOOM", node_type="worker", event="oom"))
+        assert resp.algorithm == "optimize_job_worker_create_oom_resource"
+        assert resp.memory_mb >= 1500  # peak x oom_factor
+        c.close()
+
+    def test_stage_algorithm_progression(self, brain):
+        c = BrainClient(brain.addr, "jobProg")
+        r0 = c.optimize("worker")
+        assert r0.algorithm == "optimize_job_worker_create_resource"
+        for _ in range(3):
+            c.persist_metrics("worker", cpu=1.0, memory_mb=100)
+        r1 = c.optimize("worker")
+        assert r1.algorithm == "optimize_job_worker_init_adjust_resource"
+        for _ in range(12):
+            c.persist_metrics("worker", cpu=1.0, memory_mb=100)
+        r2 = c.optimize("worker")
+        assert r2.algorithm == "optimize_job_worker_resource"
+        c.close()
+
+    def test_json_datastore_batched_flush(self, tmp_path):
+        import json as _json
+
+        from dlrover_wuqiong_tpu.brain.plugins import JsonFileDataStore
+
+        path = str(tmp_path / "ds.json")
+        ds = JsonFileDataStore(path, flush_every=3)
+        ds.append("j", "worker", {"cpu": 1, "memory_mb": 2})
+        ds.append("j", "worker", {"cpu": 1, "memory_mb": 2})
+        import os
+
+        assert not os.path.exists(path)  # below the batch threshold
+        ds.append("j", "worker", {"cpu": 1, "memory_mb": 2})
+        assert os.path.exists(path)      # batch flushed
+        data = _json.loads(open(path).read())
+        assert len(data["j"]["worker"]) == 3
+        # reload sees the same history
+        ds2 = JsonFileDataStore(path)
+        assert len(ds2.samples("j", "worker")) == 3
+
+    def test_nearest_rank_percentile(self):
+        from dlrover_wuqiong_tpu.brain.plugins import _percentile
+
+        assert _percentile([1000, 1000, 8000], 0.95) == 8000
+        assert _percentile([1, 2, 3, 4], 0.5) == 2
+        assert _percentile([5], 0.95) == 5
+
+    def test_pre_plugin_snapshot_rebuilds_fleet(self, tmp_path):
+        """Snapshots written by the pre-plugin service (no __fleet__ key)
+        must still seed the fleet prior after a restart."""
+        import json as _json
+
+        path = str(tmp_path / "old.json")
+        with open(path, "w") as f:
+            _json.dump({"legacy-job": {"worker": [
+                {"cpu": 2.0, "memory_mb": 1000}] * 3}}, f)
+        svc = BrainService(snapshot_path=path, **_OPT_KW)
+        svc.start()
+        c = BrainClient(svc.addr, "fresh-job")
+        resp = c.optimize("worker")
+        assert resp.stage != "init"       # fleet prior present
+        assert resp.memory_mb > 0
+        c.close()
+        svc.stop()

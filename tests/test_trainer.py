@@ -166,3 +166,78 @@ class TestTunedConfigLoop:
             os.environ.pop(ConfigPath.ENV_PARAL_CONFIG, None)
             master.stop()
             MasterClient.reset()
+
+
+class TestTrainerDepth:
+    """Weak-spot coverage (VERDICT r2 #8): callbacks, profiler window,
+    save-on-exit, eval cadence asserted tightly."""
+
+    def test_callbacks_cadence_and_metrics(self, tmp_path):
+        seen = []
+        args = TrainingArgs(
+            output_dir=str(tmp_path), max_steps=12, global_batch_size=8,
+            seq_len=32, warmup_steps=1, logging_steps=3, save_steps=0,
+            save_on_exit=False, strategy=[("fsdp", {})])
+        Trainer(_model(), args, _data,
+                callbacks=[lambda s, m: seen.append((s, m))]).train()
+        assert [s for s, _ in seen] == [3, 6, 9, 12]
+        for _, m in seen:
+            assert {"loss", "tokens_per_sec"} <= set(m)
+            assert np.isfinite(m["loss"]) and m["tokens_per_sec"] > 0
+
+    def test_profiler_window_produces_op_profile(self, tmp_path):
+        args = TrainingArgs(
+            output_dir=str(tmp_path / "out"), max_steps=6,
+            global_batch_size=8, seq_len=32, warmup_steps=1,
+            logging_steps=0, save_steps=0, save_on_exit=False,
+            profile_trace_dir=str(tmp_path / "trace"),
+            profile_start_step=2, profile_end_step=4,
+            strategy=[("fsdp", {})])
+        tr = Trainer(_model(), args, _data)
+        tr.train()
+        assert tr.profiler.last_profile is not None
+        cats = tr.profiler.last_profile.categories
+        assert "matmul" in cats and cats["matmul"] > 0
+        import glob
+
+        assert glob.glob(str(tmp_path / "trace" / "plugins" / "profile" /
+                             "*" / "*.xplane.pb"))
+
+    def test_save_on_exit_persists_after_crash(self, tmp_path):
+        """A mid-train exception must still leave a committed checkpoint
+        at the crash step (the finally-block save)."""
+        class Boom(RuntimeError):
+            pass
+
+        def exploding_cb(step, metrics):
+            if step >= 4:
+                raise Boom()
+
+        args = TrainingArgs(
+            output_dir=str(tmp_path), max_steps=20, global_batch_size=8,
+            seq_len=32, warmup_steps=1, logging_steps=2, save_steps=0,
+            save_on_exit=True, strategy=[("fsdp", {})])
+        tr = Trainer(_model(), args, _data, callbacks=[exploding_cb])
+        with pytest.raises(Boom):
+            tr.train()
+        tracker = (tmp_path / "checkpoints" /
+                   "latest_checkpointed_iteration.txt")
+        assert tracker.exists()
+        assert int(tracker.read_text()) == 4
+        tr.ckpt.close()
+
+    def test_eval_cadence(self, tmp_path):
+        eval_calls = []
+
+        def eval_data(step):
+            eval_calls.append(step)
+            return _data(step)
+
+        args = TrainingArgs(
+            output_dir=str(tmp_path), max_steps=8, global_batch_size=8,
+            seq_len=32, warmup_steps=1, logging_steps=0, save_steps=0,
+            eval_steps=4, max_eval_batches=2, save_on_exit=False,
+            strategy=[("fsdp", {})])
+        Trainer(_model(), args, _data, eval_data=eval_data).train()
+        # 8 steps / eval every 4 = 2 eval passes x 2 batches each
+        assert len(eval_calls) == 4
