@@ -28,9 +28,11 @@ class BrainClient:
             job_name=self.job_name, node_type=node_type, cpu=cpu,
             memory_mb=memory_mb))
 
-    def optimize(self, node_type: str) -> msg.BrainOptimizeResponse:
+    def optimize(self, node_type: str,
+                 event: str = "") -> msg.BrainOptimizeResponse:
+        """event="oom" selects the OOM-bump algorithm server-side."""
         return self._client.get(msg.BrainOptimizeRequest(
-            job_name=self.job_name, node_type=node_type))
+            job_name=self.job_name, node_type=node_type, event=event))
 
     def get_job_metrics(self, node_type: str) -> str:
         resp = self._client.get(msg.BrainJobMetricsRequest(
@@ -75,3 +77,19 @@ class BrainResourceOptimizer(LocalResourceOptimizer):
             logger.debug("brain optimize failed — using local plan",
                          exc_info=True)
         return super().plan_node_resource(node_type)
+
+    def bump_oom(self, resource: NodeResource) -> NodeResource:
+        """OOM escalation via the Brain's fleet-informed OOM algorithm,
+        floored by the local bump so the answer is always a strict
+        increase over the failed allocation (JobAutoScaler.handle_oom)."""
+        local = super().bump_oom(resource)
+        try:
+            resp = self.client.optimize("worker", event="oom")
+            if resp.memory_mb > 0:
+                return NodeResource(
+                    cpu=max(local.cpu, resp.cpu),
+                    memory_mb=max(local.memory_mb, resp.memory_mb))
+        except Exception:  # noqa: BLE001
+            logger.debug("brain oom optimize failed — local bump",
+                         exc_info=True)
+        return local

@@ -76,6 +76,8 @@ class JsonFileDataStore(MemoryDataStore):
         self._path = path
         self._flush_every = flush_every
         self._appends = 0
+        self._flush_lock = threading.Lock()  # one writer at a time: two
+        # threads sharing the per-pid tmp path would corrupt the snapshot
         self._load()
 
     def _load(self):
@@ -85,10 +87,21 @@ class JsonFileDataStore(MemoryDataStore):
             with open(self._path) as f:
                 data = json.load(f)
             if isinstance(data, dict):
+                def _valid(s):
+                    return (isinstance(s, dict)
+                            and isinstance(s.get("cpu"), (int, float))
+                            and isinstance(s.get("memory_mb"),
+                                           (int, float)))
+
                 with self._lock:
+                    # malformed entries are dropped HERE, not left to
+                    # crash every later optimize() call
                     self._data = {
-                        j: {nt: list(s) for nt, s in by_type.items()}
-                        for j, by_type in data.items()}
+                        j: {nt: [s for s in samples if _valid(s)]
+                            for nt, samples in by_type.items()
+                            if isinstance(samples, list)}
+                        for j, by_type in data.items()
+                        if isinstance(by_type, dict)}
                     if FLEET_JOB not in self._data:
                         # snapshot from the pre-plugin service (no fleet
                         # key): rebuild the fleet prior from every job's
@@ -105,16 +118,19 @@ class JsonFileDataStore(MemoryDataStore):
         try:
             with self._lock:
                 payload = json.dumps(self._data)
-            tmp = f"{self._path}.tmp.{os.getpid()}"
-            with open(tmp, "w") as f:
-                f.write(payload)
-            os.replace(tmp, self._path)
+            with self._flush_lock:
+                tmp = f"{self._path}.tmp.{os.getpid()}"
+                with open(tmp, "w") as f:
+                    f.write(payload)
+                os.replace(tmp, self._path)
         except OSError:
             logger.exception("brain datastore flush failed")
 
     def _dirty(self):
-        self._appends += 1
-        if self._appends % self._flush_every == 0:
+        with self._flush_lock:
+            self._appends += 1
+            due = self._appends % self._flush_every == 0
+        if due:
             self.flush()
 
 
@@ -187,7 +203,9 @@ def _running_resource(samples, fleet_samples, cfg) -> NodeResource:
 
 @register_algorithm("optimize_job_worker_create_oom_resource")
 def _oom_resource(samples, fleet_samples, cfg) -> NodeResource:
-    """After an OOM: bump past the largest usage ever seen."""
+    """After an OOM: a strict increase over BOTH the plan that just failed
+    and the largest usage seen — sampling can miss the spike, and
+    re-provisioning the failed allocation just OOMs again."""
     base = _running_resource(samples or fleet_samples
                              or [{"cpu": cfg["default_resource"].cpu,
                                   "memory_mb":
@@ -198,7 +216,7 @@ def _oom_resource(samples, fleet_samples, cfg) -> NodeResource:
     return NodeResource(
         cpu=base.cpu,
         memory_mb=min(cfg["max_memory_mb"],
-                      max(base.memory_mb, peak * cfg["oom_factor"])))
+                      max(base.memory_mb, peak) * cfg["oom_factor"]))
 
 
 # ----------------------------------------------------------------- optimizer
@@ -257,4 +275,10 @@ class BrainOptimizer:
         else:
             name = "optimize_job_worker_resource"
         plan = _ALGORITHMS[name](samples, fleet, self._cfg)
+        # floors (parity LocalResourceOptimizer.plan_node_resource): never
+        # recommend below one core / the configured default memory
+        plan = NodeResource(
+            cpu=max(1.0, plan.cpu),
+            memory_mb=max(self._cfg["default_resource"].memory_mb,
+                          plan.memory_mb))
         return plan, stage, name

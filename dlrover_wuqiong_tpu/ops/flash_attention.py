@@ -404,15 +404,20 @@ def _attention_reference(q, k, v, causal: bool, sm_scale: float):
 # ---------------------------------------------------------------- public API
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def flash_attention(q, k, v, causal: bool = True,
                     sm_scale: Optional[float] = None,
-                    block_q: int = 1024, block_k: int = 1024):
+                    block_q: int = 1024, block_k: int = 1024,
+                    bwd_block_q: int = 0, bwd_block_k: int = 0):
     """Multi-head attention, FA2-style.
 
     Args: q (b, h, sq, d); k, v (b, h, sk, d).  Returns (b, h, sq, d).
+    `bwd_block_q`/`bwd_block_k` tile the dq/dkv backward kernels
+    independently (0 = inherit block_q/block_k — swept best at the bench
+    shape, README table).
     """
-    out, _ = _fa_fwd(q, k, v, causal, sm_scale, block_q, block_k)
+    out, _ = _fa_fwd(q, k, v, causal, sm_scale, block_q, block_k,
+                     bwd_block_q, bwd_block_k)
     return out
 
 
@@ -544,22 +549,26 @@ def _fa_bwd_impl(causal, sm_scale, block_q, block_k, res, g, glse):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-def _fa_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+def _fa_fwd(q, k, v, causal, sm_scale, block_q, block_k,
+            bwd_block_q=0, bwd_block_k=0):
     (out, _), res = _fa_fwd_lse(q, k, v, causal, sm_scale, block_q, block_k)
     return out, res
 
 
-def _fa_bwd(causal, sm_scale, block_q, block_k, res, g):
-    return _fa_bwd_impl(causal, sm_scale, block_q, block_k, res, g, None)
+def _fa_bwd(causal, sm_scale, block_q, block_k, bwd_block_q, bwd_block_k,
+            res, g):
+    return _fa_bwd_impl(causal, sm_scale, bwd_block_q or block_q,
+                        bwd_block_k or block_k, res, g, None)
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def flash_attention_with_lse(q, k, v, causal: bool = True,
                              sm_scale: Optional[float] = None,
-                             block_q: int = 1024, block_k: int = 1024):
+                             block_q: int = 1024, block_k: int = 1024,
+                             bwd_block_q: int = 0, bwd_block_k: int = 0):
     """Like `flash_attention` but also returns lse (b, h, sq) f32 — the
     building block for ring/blockwise attention where partial results over
     disjoint key sets merge by logsumexp weights.  Differentiable in both
@@ -568,13 +577,16 @@ def flash_attention_with_lse(q, k, v, causal: bool = True,
     return out, lse
 
 
-def _fa_lse_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+def _fa_lse_fwd(q, k, v, causal, sm_scale, block_q, block_k,
+                bwd_block_q=0, bwd_block_k=0):
     return _fa_fwd_lse(q, k, v, causal, sm_scale, block_q, block_k)
 
 
-def _fa_lse_bwd(causal, sm_scale, block_q, block_k, res, gs):
+def _fa_lse_bwd(causal, sm_scale, block_q, block_k, bwd_block_q,
+                bwd_block_k, res, gs):
     g, glse = gs
-    return _fa_bwd_impl(causal, sm_scale, block_q, block_k, res, g,
+    return _fa_bwd_impl(causal, sm_scale, bwd_block_q or block_q,
+                        bwd_block_k or block_k, res, g,
                         glse.astype(jnp.float32))
 
 

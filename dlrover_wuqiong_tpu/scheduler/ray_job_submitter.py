@@ -35,10 +35,10 @@ def load_conf(path: str) -> Dict:
     with open(path, encoding="utf-8") as f:
         text = f.read()
     if path.endswith(".json"):
-        return json.loads(text)
+        return json.loads(text) or {}
     import yaml
 
-    return yaml.safe_load(text)
+    return yaml.safe_load(text) or {}  # empty file → {}, not None
 
 
 class RayJobSubmitter:
