@@ -305,12 +305,14 @@ def auto_accelerate(
             raise ValueError(
                 "pipeline_parallel does not compose with ring/ulysses "
                 "sequence parallel yet — use impl='gspmd' or drop one")
-        if getattr(model.config, "moe_experts", 0):
-            # PipelinedLM.apply drops sown intermediates, which would
-            # silently lose the MoE load-balancing aux loss
+        if getattr(model.config, "moe_experts", 0) and \
+                ctx.extra.get("pp_schedule") == "1f1b":
+            # gpipe/interleaved carry the router aux loss through the
+            # schedule as an explicit scalar; the manual 1f1b backward
+            # does not seed the aux cotangent yet
             raise ValueError(
-                "pipeline_parallel does not support MoE models yet "
-                "(the router aux loss cannot flow out of the pipeline)")
+                "pipeline schedule '1f1b' does not support MoE models — "
+                "use schedule='gpipe' or 'interleaved'")
         n_layer = getattr(model.config, "n_layer",
                           getattr(model.config, "num_layers", None))
         if n_layer is None or n_layer % ctx.plan.pp:

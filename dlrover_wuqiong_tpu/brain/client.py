@@ -86,9 +86,12 @@ class BrainResourceOptimizer(LocalResourceOptimizer):
         try:
             resp = self.client.optimize("worker", event="oom")
             if resp.memory_mb > 0:
+                # clamp to the LOCAL cap: the brain's own cap may exceed
+                # what any node in this cluster can actually satisfy
                 return NodeResource(
                     cpu=max(local.cpu, resp.cpu),
-                    memory_mb=max(local.memory_mb, resp.memory_mb))
+                    memory_mb=min(self._max_memory_mb,
+                                  max(local.memory_mb, resp.memory_mb)))
         except Exception:  # noqa: BLE001
             logger.debug("brain oom optimize failed — local bump",
                          exc_info=True)

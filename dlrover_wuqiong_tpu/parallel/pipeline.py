@@ -99,14 +99,39 @@ def circular_layer_order(n_layer: int, pp: int, v: int) -> List[int]:
     return order
 
 
+def _apply_block(block_fn, pl, h):
+    """block_fn may return h or (h, aux_scalar) — MoE blocks surface their
+    load-balancing aux loss this way (sown intermediates cannot cross the
+    shard_map/scan boundary)."""
+    out = block_fn(pl, h)
+    if isinstance(out, tuple):
+        h2, aux = out
+        return h2, aux.astype(jnp.float32)
+    return out, jnp.zeros((), jnp.float32)
+
+
+def _scan_blocks(block_fn, h, layer_params):
+    """Sequentially apply stacked layers, accumulating aux: the ONE
+    aux-carry implementation shared by every schedule."""
+    def _layer(carry, pl):
+        h, a = carry
+        h2, a2 = _apply_block(block_fn, pl, h)
+        return (h2, a + a2), None
+
+    (h, aux), _ = jax.lax.scan(_layer, (h, jnp.zeros((), jnp.float32)),
+                               layer_params)
+    return h, aux
+
+
 def pipeline_apply(block_fn: Callable[[Any, jax.Array], jax.Array],
                    stacked_params: Any, x: jax.Array, mesh: Mesh,
                    num_microbatches: int, schedule: str = "gpipe",
-                   virtual_stages: int = 1) -> jax.Array:
+                   virtual_stages: int = 1, with_aux: bool = False):
     """Run a stacked layer pytree as a `pp`-stage pipeline over `x`.
 
     Args:
-        block_fn: (one_layer_params, x) -> x, applied per layer.
+        block_fn: (one_layer_params, x) -> x  OR  -> (x, aux_scalar)
+            (MoE load-balance loss), applied per layer.
         stacked_params: pytree whose leaves have a leading layer axis L
             (sharded P("pp") — L must divide evenly by pp).  For
             schedule="interleaved" the layer axis must already be in
@@ -116,13 +141,14 @@ def pipeline_apply(block_fn: Callable[[Any, jax.Array], jax.Array],
         schedule: "gpipe" | "interleaved" ("1f1b" is a training schedule —
             see `pipeline_1f1b`; its forward alone is gpipe).
         virtual_stages: v chunks per device for "interleaved".
-    Returns (B, T, C), replicated over pp.
+        with_aux: also return the mean-over-microbatches aux loss
+            (replicated over pp, differentiable).
+    Returns (B, T, C) replicated over pp — or ((B, T, C), aux) with_aux.
     """
     pp = mesh.shape.get("pp", 1)
     if pp == 1:
-        def _layer(h, pl):
-            return block_fn(pl, h), None
-        return jax.lax.scan(_layer, x, stacked_params)[0]
+        out, aux = _scan_blocks(block_fn, x, stacked_params)
+        return (out, aux) if with_aux else out
 
     B = x.shape[0]
     M = num_microbatches
@@ -130,8 +156,10 @@ def pipeline_apply(block_fn: Callable[[Any, jax.Array], jax.Array],
         raise ValueError(f"batch {B} not divisible by microbatches {M}")
     xm = x.reshape(M, B // M, *x.shape[1:])
     if schedule == "interleaved" and virtual_stages > 1:
-        return _interleaved_apply(block_fn, stacked_params, xm, mesh,
-                                  virtual_stages).reshape(B, *x.shape[1:])
+        out, aux = _interleaved_apply(block_fn, stacked_params, xm, mesh,
+                                      virtual_stages)
+        out = out.reshape(B, *x.shape[1:])
+        return (out, aux) if with_aux else out
 
     def _stage_body(sp_local, xm_full):
         # sp_local leaves: (L/pp, ...) — this stage's layer slice
@@ -140,16 +168,15 @@ def pipeline_apply(block_fn: Callable[[Any, jax.Array], jax.Array],
         n_ticks = M + pp - 1
         fwd_perm = [(i, i + 1) for i in range(pp - 1)]
 
-        def _apply_stage(h):
-            def _layer(h, pl):
-                return block_fn(pl, h), None
-            return jax.lax.scan(_layer, h, sp_local)[0]
-
         def _tick(carry, t):
-            buf, outs = carry
+            buf, outs, aux_acc = carry
             mb_in = jnp.clip(t, 0, M - 1)
             h_in = jnp.where(stage == 0, xm_full[mb_in], buf)
-            y = _apply_stage(h_in)
+            y, aux_t = _scan_blocks(block_fn, h_in, sp_local)
+            # fill/drain ticks compute on garbage: only count aux for this
+            # stage's valid microbatch (m = t - stage)
+            valid = (t - stage >= 0) & (t - stage < M)
+            aux_acc = aux_acc + jnp.where(valid, aux_t, 0.0)
             # hand activation to the next stage (no wraparound)
             buf_next = jax.lax.ppermute(y, "pp", fwd_perm)
             # last stage finished microbatch t-(pp-1) at this tick
@@ -157,22 +184,27 @@ def pipeline_apply(block_fn: Callable[[Any, jax.Array], jax.Array],
             write = (stage == pp - 1) & (out_idx >= 0)
             outs_upd = outs.at[jnp.clip(out_idx, 0, M - 1)].set(y)
             outs = jnp.where(write, outs_upd, outs)
-            return (buf_next, outs), None
+            return (buf_next, outs, aux_acc), None
 
         buf0 = jnp.zeros_like(xm_full[0])
         outs0 = jnp.zeros_like(xm_full)
-        (_, outs), _ = jax.lax.scan(_tick, (buf0, outs0),
-                                    jnp.arange(n_ticks))
+        (_, outs, aux_acc), _ = jax.lax.scan(
+            _tick, (buf0, outs0, jnp.zeros((), jnp.float32)),
+            jnp.arange(n_ticks))
         # only the last stage holds real outputs; broadcast over pp so the
         # head computes identically (and cheaply) on every stage
         outs = jax.lax.psum(
             jnp.where(stage == pp - 1, outs, jnp.zeros_like(outs)), "pp")
-        return outs
+        # per-stage aux sums over pp; /M = mean over microbatches (matches
+        # the dense model's single whole-batch aux)
+        aux = jax.lax.psum(aux_acc, "pp") / M
+        return outs, aux
 
-    out = _pp_shard_map(
+    out, aux = _pp_shard_map(
         _stage_body, mesh,
-        in_specs=(P("pp"), P()), out_specs=P())(stacked_params, xm)
-    return out.reshape(B, *x.shape[1:])
+        in_specs=(P("pp"), P()), out_specs=(P(), P()))(stacked_params, xm)
+    out = out.reshape(B, *x.shape[1:])
+    return (out, aux) if with_aux else out
 
 
 def _interleaved_apply(block_fn, stacked_params, xm, mesh, v):
@@ -204,13 +236,10 @@ def _interleaved_apply(block_fn, stacked_params, xm, mesh, v):
             chunk = jax.tree.map(
                 lambda a: jax.lax.dynamic_slice_in_dim(a, j * lc, lc, 0),
                 sp_local)
-
-            def _layer(h, pl):
-                return block_fn(pl, h), None
-            return jax.lax.scan(_layer, h, chunk)[0]
+            return _scan_blocks(block_fn, h, chunk)
 
         def _tick(carry, t):
-            buf, outs = carry
+            buf, outs, aux_acc = carry
             u = t - stage
             r = jnp.mod(u, pp)            # m % pp
             k = jnp.floor_divide(u, pp)   # j + v * (m // pp)
@@ -220,22 +249,25 @@ def _interleaved_apply(block_fn, stacked_params, xm, mesh, v):
             m = jnp.clip(r + pp * q, 0, M - 1)
             first = (stage == 0) & (j == 0)
             h_in = jnp.where(first, xm_full[m], buf)
-            y = _apply_chunk(j, h_in)
+            y, aux_t = _apply_chunk(j, h_in)
+            aux_acc = aux_acc + jnp.where(valid, aux_t, 0.0)
             is_out = valid & (stage == pp - 1) & (j == v - 1)
             outs = jnp.where(is_out, outs.at[m].set(y), outs)
-            return (jax.lax.ppermute(y, "pp", perm), outs), None
+            return (jax.lax.ppermute(y, "pp", perm), outs, aux_acc), None
 
         buf0 = jnp.zeros_like(xm_full[0])
         outs0 = jnp.zeros_like(xm_full)
-        (_, outs), _ = jax.lax.scan(_tick, (buf0, outs0),
-                                    jnp.arange(n_ticks))
+        (_, outs, aux_acc), _ = jax.lax.scan(
+            _tick, (buf0, outs0, jnp.zeros((), jnp.float32)),
+            jnp.arange(n_ticks))
         outs = jax.lax.psum(
             jnp.where(stage == pp - 1, outs, jnp.zeros_like(outs)), "pp")
-        return outs
+        aux = jax.lax.psum(aux_acc, "pp") / M
+        return outs, aux
 
     return _pp_shard_map(
         _stage_body, mesh,
-        in_specs=(P("pp"), P()), out_specs=P())(stacked_params, xm)
+        in_specs=(P("pp"), P()), out_specs=(P(), P()))(stacked_params, xm)
 
 
 # ------------------------------------------------------------ 1F1B training
@@ -489,12 +521,22 @@ class PipelinedLM:
         params = variables["params"]
         x = self._embed(params, idx)
         block_fn = self._block_fn(params, idx, deterministic)
-        x = pipeline_apply(block_fn, params["blocks"], x, self.mesh,
-                           self.num_microbatches, schedule=self.schedule,
-                           virtual_stages=self.virtual_stages)
+        want_aux = bool(getattr(self.config, "moe_experts", 0))
+        res = pipeline_apply(block_fn, params["blocks"], x, self.mesh,
+                             self.num_microbatches, schedule=self.schedule,
+                             virtual_stages=self.virtual_stages,
+                             with_aux=want_aux)
+        if want_aux:
+            x, aux = res
+        else:
+            x = res
         logits = self._head(params, x)
         if mutable:
-            return logits, {}
+            # surface the MoE aux loss the way flax sow would, so
+            # make_lm_loss's collect_moe_aux_loss finds it
+            inter = ({"intermediates": {"moe_aux_loss": (aux,)}}
+                     if want_aux else {})
+            return logits, inter
         return logits
 
     # -- 1F1B training path
@@ -571,7 +613,19 @@ class PipelinedLM:
         if self.block_builder is not None:
             return self.block_builder(params, idx, deterministic)
         cfg = self.config
-        if "wte" in params:
+        if getattr(cfg, "moe_experts", 0) and "wte" in params:
+            # MoE blocks: capture the sown load-balance aux loss and carry
+            # it through the pipeline as an explicit scalar
+            from ..models.gpt import Block
+            from ..models.moe import collect_moe_aux_loss
+
+            def fn(pl, h):
+                h2, upd = Block(cfg).apply(
+                    {"params": pl}, h, deterministic,
+                    mutable=["intermediates"])
+                return h2, collect_moe_aux_loss(
+                    upd.get("intermediates", {}))
+        elif "wte" in params:
             from ..models.gpt import Block
 
             fn = lambda pl, h: Block(cfg).apply(  # noqa: E731

@@ -35,7 +35,7 @@ def load_conf(path: str) -> Dict:
     with open(path, encoding="utf-8") as f:
         text = f.read()
     if path.endswith(".json"):
-        return json.loads(text) or {}
+        return (json.loads(text) if text.strip() else {}) or {}
     import yaml
 
     return yaml.safe_load(text) or {}  # empty file → {}, not None

@@ -419,6 +419,72 @@ class TestPipelineTraining:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    atol=1e-5)
 
+    @pytest.mark.parametrize("schedule,vstages",
+                             [("gpipe", 1), ("interleaved", 2)])
+    def test_moe_through_pipeline(self, schedule, vstages):
+        """MoE models pipeline: the router aux loss crosses the schedule
+        as an explicit scalar and matches the dense model's."""
+        from dlrover_wuqiong_tpu.trainer.train_step import make_lm_loss
+
+        cfg = dataclasses.replace(GPTConfig.nano(), remat=False,
+                                  use_flash_attention=False,
+                                  n_layer=2 * vstages, moe_experts=4,
+                                  dtype=jnp.float32)
+        mesh = _pp_mesh(pp=2)
+        model = GPT(cfg)
+        dense_params = model.init_params(jax.random.PRNGKey(0))
+        plm = PipelinedLM(model, mesh, num_microbatches=2,
+                          schedule=schedule, virtual_stages=vstages)
+        pp_params = plm.from_flat_params(dense_params)
+        data = jax.random.randint(jax.random.PRNGKey(1), (4, 17), 0,
+                                  cfg.vocab_size)
+        batch = {"input_ids": data[:, :-1], "labels": data[:, 1:]}
+        with mesh:
+            loss = jax.jit(make_lm_loss(plm.apply))(pp_params, batch)
+        dense_loss = make_lm_loss(model.apply)(dense_params, batch)
+        # router statistics (capacity drops, aux balance) are computed per
+        # microbatch in a pipeline vs whole-batch densely — standard
+        # microbatched-MoE semantics, so close but not bitwise equal
+        np.testing.assert_allclose(float(loss), float(dense_loss),
+                                   atol=2e-2)
+        # the aux term is actually present (loss > plain ce)
+        logits = model.apply({"params": dense_params},
+                             batch["input_ids"])
+        from dlrover_wuqiong_tpu.models.gpt import cross_entropy_loss
+
+        ce = float(cross_entropy_loss(logits, batch["labels"]))
+        assert float(loss) > ce
+
+    def test_moe_pipeline_trains_e2e(self):
+        cfg = dataclasses.replace(GPTConfig.nano(), remat=False,
+                                  use_flash_attention=False,
+                                  moe_experts=4, dtype=jnp.float32)
+        res = auto_accelerate(
+            GPT(cfg), optimizer=optax.adam(1e-2),
+            strategy=[("pipeline_parallel", {"size": 2,
+                                             "microbatches": 2}),
+                      ("fsdp", {})],
+            devices=jax.devices()[:4])
+        data = jax.random.randint(jax.random.PRNGKey(0), (8, 33), 0,
+                                  cfg.vocab_size)
+        batch = res.place_batch({"input_ids": data[:, :-1],
+                                 "labels": data[:, 1:]})
+        state, losses = res.state, []
+        for _ in range(5):
+            state, m = res.train_step(state, batch)
+            losses.append(float(m["loss"]))
+        assert losses[-1] < losses[0], losses
+
+    def test_moe_1f1b_still_rejected(self):
+        cfg = dataclasses.replace(GPTConfig.nano(), remat=False,
+                                  moe_experts=4)
+        with pytest.raises(ValueError, match="1f1b.*MoE|MoE"):
+            auto_accelerate(
+                GPT(cfg),
+                strategy=[("pipeline_parallel",
+                           {"size": 2, "schedule": "1f1b"})],
+                devices=jax.devices()[:2])
+
     def test_pp_rejects_indivisible_layers(self):
         cfg = dataclasses.replace(GPTConfig.nano(), remat=False)  # 2 layers
         with pytest.raises(ValueError, match="divisible"):
