@@ -305,14 +305,7 @@ def auto_accelerate(
             raise ValueError(
                 "pipeline_parallel does not compose with ring/ulysses "
                 "sequence parallel yet — use impl='gspmd' or drop one")
-        if getattr(model.config, "moe_experts", 0) and \
-                ctx.extra.get("pp_schedule") == "1f1b":
-            # gpipe/interleaved carry the router aux loss through the
-            # schedule as an explicit scalar; the manual 1f1b backward
-            # does not seed the aux cotangent yet
-            raise ValueError(
-                "pipeline schedule '1f1b' does not support MoE models — "
-                "use schedule='gpipe' or 'interleaved'")
+        # (MoE x 1f1b is rejected by PipelinedLM.__post_init__ itself)
         n_layer = getattr(model.config, "n_layer",
                           getattr(model.config, "num_layers", None))
         if n_layer is None or n_layer % ctx.plan.pp:
@@ -323,16 +316,13 @@ def auto_accelerate(
             ctx.accum_steps, 2 * ctx.plan.pp)
         pp_schedule = ctx.extra.get("pp_schedule", "gpipe")
         pp_virtual = ctx.extra.get("pp_virtual_stages", 1)
-        if pp_schedule == "1f1b":
-            if loss_fn is not None:
-                raise ValueError(
-                    "pipeline schedule '1f1b' computes its own head loss "
-                    "(cross-entropy) inside the schedule and cannot honor a "
-                    "custom loss_fn — use schedule='gpipe'/'interleaved'")
-            if ctx.extra.get("local_sgd") is not None:
-                raise ValueError(
-                    "pipeline schedule '1f1b' does not compose with "
-                    "local_sgd — its manual grads bypass the DiLoCo step")
+        if pp_schedule == "1f1b" and loss_fn is not None:
+            raise ValueError(
+                "pipeline schedule '1f1b' computes its own head loss "
+                "(cross-entropy) inside the schedule and cannot honor a "
+                "custom loss_fn — use schedule='gpipe'/'interleaved'")
+        # (local_sgd x pp of ANY schedule is rejected in the local_sgd
+        # branch below — nested manual shard_map axes)
         model = PipelinedLM(model, mesh, microbatches,
                             schedule=pp_schedule,
                             virtual_stages=pp_virtual)
@@ -361,6 +351,11 @@ def auto_accelerate(
             raise ValueError(
                 "local_sgd needs ('data_parallel', {'size': R>=2}) — the "
                 "dp axis carries the locally-training replica groups")
+        if ctx.plan.pp > 1:
+            raise ValueError(
+                "local_sgd does not compose with pipeline_parallel — the "
+                "DiLoCo step is manual over dp while the pipeline is "
+                "manual over pp, and the two shard_maps cannot nest")
         if ctx.accum_steps > 1:
             raise ValueError("local_sgd does not compose with grad_accum "
                              "yet")

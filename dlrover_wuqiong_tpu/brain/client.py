@@ -72,7 +72,11 @@ class BrainResourceOptimizer(LocalResourceOptimizer):
             better_local = (resp.stage == "init"
                             and self.stage(node_type) != "init")
             if resp.memory_mb > 0 and not better_local:
-                return NodeResource(cpu=resp.cpu, memory_mb=resp.memory_mb)
+                # clamp to the LOCAL cap — the brain may be tuned for a
+                # fleet whose nodes are larger than this cluster's
+                return NodeResource(
+                    cpu=resp.cpu,
+                    memory_mb=min(self._max_memory_mb, resp.memory_mb))
         except Exception:  # noqa: BLE001
             logger.debug("brain optimize failed — using local plan",
                          exc_info=True)

@@ -481,11 +481,21 @@ class PipelinedLM:
     head_fn: Optional[Callable] = None       # (head_params, h) -> logits
     embed_keys: Optional[Tuple[str, ...]] = None
     head_keys: Optional[Tuple[str, ...]] = None
+    # does block_fn return (h, aux)?  None = derive: MoE configs using the
+    # built-in adapters do; custom block_builders must say so explicitly
+    # (a silent zero aux would hide a dropped balance loss)
+    block_returns_aux: Optional[bool] = None
 
     def __post_init__(self):
         self.config = self.inner.config
         self._n_layer = getattr(self.config, "n_layer",
                                 getattr(self.config, "num_layers", 0))
+        if self.schedule == "1f1b" and \
+                getattr(self.config, "moe_experts", 0):
+            raise ValueError(
+                "pipeline schedule '1f1b' does not support MoE models — "
+                "its manual backward does not seed the router aux-loss "
+                "cotangent; use schedule='gpipe' or 'interleaved'")
         pp = self.mesh.shape.get("pp", 1)
         if self.schedule == "interleaved":
             self._order = circular_layer_order(self._n_layer, pp,
@@ -521,7 +531,10 @@ class PipelinedLM:
         params = variables["params"]
         x = self._embed(params, idx)
         block_fn = self._block_fn(params, idx, deterministic)
-        want_aux = bool(getattr(self.config, "moe_experts", 0))
+        want_aux = (self.block_returns_aux
+                    if self.block_returns_aux is not None
+                    else bool(getattr(self.config, "moe_experts", 0))
+                    and self.block_builder is None)
         res = pipeline_apply(block_fn, params["blocks"], x, self.mesh,
                              self.num_microbatches, schedule=self.schedule,
                              virtual_stages=self.virtual_stages,

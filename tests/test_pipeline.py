@@ -475,6 +475,38 @@ class TestPipelineTraining:
             losses.append(float(m["loss"]))
         assert losses[-1] < losses[0], losses
 
+    def test_moe_pp_ep_composes(self):
+        """Expert parallelism composes with the pipeline: experts shard
+        over ep inside the stage while layers shard over pp."""
+        cfg = dataclasses.replace(GPTConfig.nano(), remat=False,
+                                  use_flash_attention=False,
+                                  moe_experts=4, dtype=jnp.float32)
+        res = auto_accelerate(
+            GPT(cfg), optimizer=optax.adam(1e-2),
+            strategy=[("pipeline_parallel", {"size": 2,
+                                             "microbatches": 2}),
+                      ("expert_parallel", {"size": 2}), ("fsdp", {})],
+            devices=jax.devices()[:8])
+        data = jax.random.randint(jax.random.PRNGKey(0), (8, 33), 0,
+                                  cfg.vocab_size)
+        batch = res.place_batch({"input_ids": data[:, :-1],
+                                 "labels": data[:, 1:]})
+        state, losses = res.state, []
+        for _ in range(4):
+            state, m = res.train_step(state, batch)
+            losses.append(float(m["loss"]))
+        assert losses[-1] < losses[0], losses
+
+    def test_local_sgd_pp_rejected_clearly(self):
+        cfg = dataclasses.replace(GPTConfig.nano(), remat=False)
+        with pytest.raises(ValueError, match="local_sgd.*pipeline"):
+            auto_accelerate(
+                GPT(cfg),
+                strategy=[("pipeline_parallel", {"size": 2}),
+                          ("data_parallel", {"size": 2}),
+                          ("local_sgd", {"sync_every": 2})],
+                devices=jax.devices()[:4])
+
     def test_moe_1f1b_still_rejected(self):
         cfg = dataclasses.replace(GPTConfig.nano(), remat=False,
                                   moe_experts=4)
