@@ -321,8 +321,13 @@ def auto_accelerate(
                 "pipeline schedule '1f1b' computes its own head loss "
                 "(cross-entropy) inside the schedule and cannot honor a "
                 "custom loss_fn — use schedule='gpipe'/'interleaved'")
-        # (local_sgd x pp of ANY schedule is rejected in the local_sgd
-        # branch below — nested manual shard_map axes)
+        if ctx.extra.get("local_sgd") is not None:
+            # reject HERE, before PipelinedLM wrapping and the (possibly
+            # many-GB) init_params below burn work on a doomed config
+            raise ValueError(
+                "local_sgd does not compose with pipeline_parallel — the "
+                "DiLoCo step is manual over dp while the pipeline is "
+                "manual over pp, and the two shard_maps cannot nest")
         model = PipelinedLM(model, mesh, microbatches,
                             schedule=pp_schedule,
                             virtual_stages=pp_virtual)
@@ -351,11 +356,8 @@ def auto_accelerate(
             raise ValueError(
                 "local_sgd needs ('data_parallel', {'size': R>=2}) — the "
                 "dp axis carries the locally-training replica groups")
-        if ctx.plan.pp > 1:
-            raise ValueError(
-                "local_sgd does not compose with pipeline_parallel — the "
-                "DiLoCo step is manual over dp while the pipeline is "
-                "manual over pp, and the two shard_maps cannot nest")
+        # (local_sgd x pipeline is rejected earlier, in the pp branch,
+        # before any parameter initialization)
         if ctx.accum_steps > 1:
             raise ValueError("local_sgd does not compose with grad_accum "
                              "yet")

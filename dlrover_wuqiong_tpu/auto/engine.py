@@ -32,6 +32,8 @@ logger = get_logger("auto_engine")
 class Candidate:
     plan: MeshPlan
     remat: bool = False
+    pp_schedule: str = "gpipe"       # | "interleaved" (virtual stages)
+    pp_virtual_stages: int = 1
     score: float = math.inf          # lower is better (estimated step s)
     peak_bytes: int = 0
     feasible: bool = True
@@ -44,7 +46,11 @@ class Candidate:
         if self.plan.sp > 1:
             out.append(("sequence_parallel", {"size": self.plan.sp}))
         if self.plan.pp > 1:
-            out.append(("pipeline_parallel", {"size": self.plan.pp}))
+            pp_cfg: Dict = {"size": self.plan.pp}
+            if self.pp_schedule != "gpipe":
+                pp_cfg["schedule"] = self.pp_schedule
+                pp_cfg["virtual_stages"] = self.pp_virtual_stages
+            out.append(("pipeline_parallel", pp_cfg))
         if self.plan.ep > 1:
             out.append(("expert_parallel", {"size": self.plan.ep}))
         if self.plan.dp > 1:
@@ -84,6 +90,13 @@ def generate_candidates(num_devices: int, n_head: int = 0,
                 remats = (False, True) if with_remat else (False,)
                 for remat in remats:
                     out.append(Candidate(plan=plan, remat=remat))
+                    if pp > 1 and n_layer and n_layer % (pp * 2) == 0:
+                        # interleaved virtual stages shrink the bubble
+                        # from (pp-1)/(M+pp-1) to (pp-1)/(2M+pp-1); the
+                        # compile-and-score pass ranks it for real
+                        out.append(Candidate(plan=plan, remat=remat,
+                                             pp_schedule="interleaved",
+                                             pp_virtual_stages=2))
     return out
 
 
@@ -160,6 +173,16 @@ def score_candidate(cand: Candidate, model, optimizer, sample_batch: Dict,
     peak_flops, bw = _device_roofline(devices[0])
     per_dev_flops = flops  # cost analysis is already per-program(device)
     cand.score = max(per_dev_flops / peak_flops, bytes_accessed / bw)
+    if cand.plan.pp > 1:
+        # roofline counts compute, not idle ticks — fold in the schedule's
+        # fill/drain bubble (this is what lets an interleaved candidate
+        # beat its gpipe twin without measure=True)
+        from ..parallel.pipeline import schedule_ticks
+
+        m = 2 * cand.plan.pp  # accelerate's default microbatch count
+        _, bubble = schedule_ticks(cand.pp_schedule, m, cand.plan.pp,
+                                   cand.pp_virtual_stages)
+        cand.score = cand.score / max(1e-9, 1.0 - bubble)
     if cand.score == 0:
         cand.score = math.inf
     return cand
@@ -182,7 +205,10 @@ def search_strategy(model, optimizer, sample_batch: Dict,
     for c in cands:
         score_candidate(c, model, optimizer, sample_batch, devices,
                         measure=measure, hbm_per_device=hbm_per_device)
-        logger.info("  %s remat=%s → %s", c.plan.describe(), c.remat,
+        sched = ("" if c.plan.pp <= 1 or c.pp_schedule == "gpipe"
+                 else f" {c.pp_schedule}v{c.pp_virtual_stages}")
+        logger.info("  %s%s remat=%s → %s", c.plan.describe(), sched,
+                    c.remat,
                     f"score={c.score:.4g}" if c.feasible
                     else f"infeasible ({c.reason[:60]})")
     feasible = [c for c in cands if c.feasible]

@@ -531,10 +531,18 @@ class PipelinedLM:
         params = variables["params"]
         x = self._embed(params, idx)
         block_fn = self._block_fn(params, idx, deterministic)
-        want_aux = (self.block_returns_aux
-                    if self.block_returns_aux is not None
-                    else bool(getattr(self.config, "moe_experts", 0))
-                    and self.block_builder is None)
+        if self.block_returns_aux is not None:
+            want_aux = self.block_returns_aux
+        elif getattr(self.config, "moe_experts", 0) and \
+                self.block_builder is not None:
+            # guessing either way silently drops or fabricates the router
+            # balance loss — demand explicitness
+            raise ValueError(
+                "MoE config with a custom block_builder: set "
+                "block_returns_aux=True if the builder's block_fn returns "
+                "(h, aux), False if the aux loss is handled elsewhere")
+        else:
+            want_aux = bool(getattr(self.config, "moe_experts", 0))
         res = pipeline_apply(block_fn, params["blocks"], x, self.mesh,
                              self.num_microbatches, schedule=self.schedule,
                              virtual_stages=self.virtual_stages,

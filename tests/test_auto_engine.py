@@ -114,3 +114,27 @@ class TestBayesianOptimizer:
             bo.tell(cfg, (cfg["a"] - 0.3) ** 2 + (cfg["b"] - 0.6) ** 2)
         best_cfg, best_y = bo.best()
         assert best_y < 0.1
+
+
+class TestScheduleCandidates:
+    def test_interleaved_candidates_emitted(self):
+        from dlrover_wuqiong_tpu.auto.engine import generate_candidates
+
+        cands = generate_candidates(8, n_head=4, n_layer=8,
+                                    with_remat=False)
+        inter = [c for c in cands if c.pp_schedule == "interleaved"]
+        assert inter, "expected interleaved pp candidates"
+        for c in inter:
+            assert c.plan.pp > 1
+            assert c.pp_virtual_stages == 2
+            # strategy round-trips the schedule config
+            pp_cfg = dict(c.strategy())["pipeline_parallel"]
+            assert pp_cfg["schedule"] == "interleaved"
+            assert pp_cfg["virtual_stages"] == 2
+
+    def test_no_interleaved_when_layers_dont_divide(self):
+        from dlrover_wuqiong_tpu.auto.engine import generate_candidates
+
+        cands = generate_candidates(4, n_head=4, n_layer=2,
+                                    with_remat=False)
+        assert not [c for c in cands if c.pp_schedule == "interleaved"]
