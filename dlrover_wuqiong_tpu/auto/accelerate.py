@@ -312,8 +312,10 @@ def auto_accelerate(
             raise ValueError(
                 f"pipeline_parallel needs layers ({n_layer}) divisible by "
                 f"pp={ctx.plan.pp}")
-        microbatches = ctx.extra.get("pp_microbatches") or max(
-            ctx.accum_steps, 2 * ctx.plan.pp)
+        from ..parallel.pipeline import default_pp_microbatches
+
+        microbatches = ctx.extra.get("pp_microbatches") or \
+            default_pp_microbatches(ctx.accum_steps, ctx.plan.pp)
         pp_schedule = ctx.extra.get("pp_schedule", "gpipe")
         pp_virtual = ctx.extra.get("pp_virtual_stages", 1)
         if pp_schedule == "1f1b" and loss_fn is not None:

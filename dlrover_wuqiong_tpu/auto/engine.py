@@ -177,9 +177,12 @@ def score_candidate(cand: Candidate, model, optimizer, sample_batch: Dict,
         # roofline counts compute, not idle ticks — fold in the schedule's
         # fill/drain bubble (this is what lets an interleaved candidate
         # beat its gpipe twin without measure=True)
-        from ..parallel.pipeline import schedule_ticks
+        from ..parallel.pipeline import (
+            default_pp_microbatches,
+            schedule_ticks,
+        )
 
-        m = 2 * cand.plan.pp  # accelerate's default microbatch count
+        m = default_pp_microbatches(1, cand.plan.pp)
         _, bubble = schedule_ticks(cand.pp_schedule, m, cand.plan.pp,
                                    cand.pp_virtual_stages)
         cand.score = cand.score / max(1e-9, 1.0 - bubble)

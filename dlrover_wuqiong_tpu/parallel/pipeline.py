@@ -81,6 +81,13 @@ def schedule_ticks(schedule: str, num_microbatches: int, pp: int,
     return ticks, (pp - 1) / ticks
 
 
+def default_pp_microbatches(accum_steps: int, pp: int) -> int:
+    """The microbatch-count policy shared by auto_accelerate (what gets
+    built) and the strategy engine's bubble estimate (what gets scored) —
+    one definition so they cannot silently diverge."""
+    return max(accum_steps, 2 * pp)
+
+
 def circular_layer_order(n_layer: int, pp: int, v: int) -> List[int]:
     """Layer permutation for the interleaved (circular) schedule.
 
@@ -496,6 +503,16 @@ class PipelinedLM:
                 "pipeline schedule '1f1b' does not support MoE models — "
                 "its manual backward does not seed the router aux-loss "
                 "cotangent; use schedule='gpipe' or 'interleaved'")
+        if getattr(self.config, "moe_experts", 0) and \
+                self.block_builder is not None and \
+                self.block_returns_aux is None:
+            # fail HERE, before any (possibly many-GB) param init —
+            # guessing either way silently drops or fabricates the
+            # router balance loss
+            raise ValueError(
+                "MoE config with a custom block_builder: set "
+                "block_returns_aux=True if the builder's block_fn returns "
+                "(h, aux), False if the aux loss is handled elsewhere")
         pp = self.mesh.shape.get("pp", 1)
         if self.schedule == "interleaved":
             self._order = circular_layer_order(self._n_layer, pp,
@@ -531,18 +548,11 @@ class PipelinedLM:
         params = variables["params"]
         x = self._embed(params, idx)
         block_fn = self._block_fn(params, idx, deterministic)
-        if self.block_returns_aux is not None:
-            want_aux = self.block_returns_aux
-        elif getattr(self.config, "moe_experts", 0) and \
-                self.block_builder is not None:
-            # guessing either way silently drops or fabricates the router
-            # balance loss — demand explicitness
-            raise ValueError(
-                "MoE config with a custom block_builder: set "
-                "block_returns_aux=True if the builder's block_fn returns "
-                "(h, aux), False if the aux loss is handled elsewhere")
-        else:
-            want_aux = bool(getattr(self.config, "moe_experts", 0))
+        # MoE + custom builder without block_returns_aux was rejected in
+        # __post_init__, so the derive below is unambiguous
+        want_aux = (self.block_returns_aux
+                    if self.block_returns_aux is not None
+                    else bool(getattr(self.config, "moe_experts", 0)))
         res = pipeline_apply(block_fn, params["blocks"], x, self.mesh,
                              self.num_microbatches, schedule=self.schedule,
                              virtual_stages=self.virtual_stages,

@@ -475,6 +475,30 @@ class TestPipelineTraining:
             losses.append(float(m["loss"]))
         assert losses[-1] < losses[0], losses
 
+    @pytest.mark.parametrize("schedule,vstages",
+                             [("1f1b", 1), ("interleaved", 2)])
+    def test_schedules_compose_with_grad_accum(self, schedule, vstages):
+        """Outer grad-accum microbatches wrap the pipeline's inner ones."""
+        cfg = dataclasses.replace(GPTConfig.nano(), remat=False,
+                                  use_flash_attention=False,
+                                  n_layer=2 * vstages, dtype=jnp.float32)
+        res = auto_accelerate(
+            GPT(cfg), optimizer=optax.adam(1e-2),
+            strategy=[("pipeline_parallel",
+                       {"size": 2, "microbatches": 2,
+                        "schedule": schedule, "virtual_stages": vstages}),
+                      ("fsdp", {}), ("grad_accum", {"steps": 2})],
+            devices=jax.devices()[:4])
+        data = jax.random.randint(jax.random.PRNGKey(0), (2, 8, 33), 0,
+                                  cfg.vocab_size)
+        batch = res.place_batch({"input_ids": data[..., :-1],
+                                 "labels": data[..., 1:]})
+        state, losses = res.state, []
+        for _ in range(4):
+            state, m = res.train_step(state, batch)
+            losses.append(float(m["loss"]))
+        assert losses[-1] < losses[0], losses
+
     def test_moe_pp_ep_composes(self):
         """Expert parallelism composes with the pipeline: experts shard
         over ep inside the stage while layers shard over pp."""
