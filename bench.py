@@ -167,12 +167,6 @@ def main():
         except Exception as e:  # noqa: BLE001
             side["real_input_error"] = repr(e)
 
-        if os.getenv("DWT_BENCH_FP8"):
-            try:
-                side.update(_fp8_run(cfg, batch, seq, steps, warmup))
-            except Exception as e:  # noqa: BLE001
-                side["fp8_error"] = repr(e)
-
     # flash-ckpt blocking save time for the train state
     try:
         from dlrover_wuqiong_tpu.checkpoint.checkpointer import (
@@ -194,6 +188,15 @@ def main():
         ck.close()
     except Exception as e:  # noqa: BLE001
         side["flash_ckpt_error"] = repr(e)
+
+    if on_tpu and os.getenv("DWT_BENCH_FP8"):
+        # LAST, with the main model's HBM released — the fp8 build needs
+        # its own params/opt state and step temps
+        del state, res
+        try:
+            side.update(_fp8_run(cfg, batch, seq, steps, warmup))
+        except Exception as e:  # noqa: BLE001
+            side["fp8_error"] = repr(e)
 
     print(json.dumps(side), file=sys.stderr)
     print(json.dumps({
@@ -249,24 +252,48 @@ def _fp8_run(cfg, batch, seq, steps, warmup):
     import optax
 
     from dlrover_wuqiong_tpu.auto.accelerate import auto_accelerate
+    from dlrover_wuqiong_tpu.common.util import is_oom_error
     from dlrover_wuqiong_tpu.models.gpt import GPT
 
+    # bf16 compute with fp8 projections ("enabled": True keeps the model
+    # bf16 — f32 compute would both OOM and measure the wrong thing); the
+    # emulation's extra scale/cast buffers may still need a smaller batch
     res8 = auto_accelerate(
         GPT(cfg), optimizer=optax.adamw(3e-4), devices=jax.devices()[:1],
-        strategy=[("fsdp", {}), ("amp", {"enabled": False, "fp8": True})])
-    data = jax.random.randint(jax.random.PRNGKey(1), (batch, seq + 1), 0,
-                              cfg.vocab_size)
-    b = res8.place_batch({"input_ids": data[:, :-1], "labels": data[:, 1:]})
-    st = res8.state
-    for _ in range(warmup):
-        st, m = res8.train_step(st, b)
-    float(m["loss"])
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        st, m = res8.train_step(st, b)
-    float(m["loss"])
-    dt = time.perf_counter() - t0
-    return {"fp8_step_ms": round(dt / steps * 1e3, 2)}
+        strategy=[("fsdp", {}), ("amp", {"fp8": True})])
+
+    def _attempt(fp8_batch):
+        # function scope: a failed attempt's device buffers die with its
+        # locals before the next (smaller) candidate allocates
+        data = jax.random.randint(jax.random.PRNGKey(1),
+                                  (fp8_batch, seq + 1), 0, cfg.vocab_size)
+        b = res8.place_batch({"input_ids": data[:, :-1],
+                              "labels": data[:, 1:]})
+        st = jax.tree.map(jnp.copy, res8.state)
+        for _ in range(warmup):
+            st, m = res8.train_step(st, b)
+        float(m["loss"])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            st, m = res8.train_step(st, b)
+        float(m["loss"])
+        return time.perf_counter() - t0
+
+    candidates = sorted({bs for bs in (batch, 16, 8) if bs <= batch},
+                        reverse=True)
+    for fp8_batch in candidates:
+        try:
+            dt = _attempt(fp8_batch)
+            return {"fp8_step_ms": round(dt / steps * 1e3, 2),
+                    "fp8_batch": fp8_batch,
+                    "fp8_tokens_per_sec": round(
+                        steps * fp8_batch * seq / dt, 1)}
+        except Exception as e:  # noqa: BLE001
+            if not is_oom_error(e):
+                raise
+            print(f"fp8 batch {fp8_batch} OOM, retrying smaller",
+                  file=sys.stderr)
+    return {"fp8_error": "all fp8 batch sizes OOM'd"}
 
 
 if __name__ == "__main__":
