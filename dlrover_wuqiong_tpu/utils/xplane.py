@@ -56,12 +56,18 @@ def _fields(buf: bytes) -> Iterator[Tuple[int, int, object]]:
             val, pos = _varint(buf, pos)
         elif wt == 2:      # length-delimited
             ln, pos = _varint(buf, pos)
+            if pos + ln > end:  # slicing would silently return short
+                raise ValueError("truncated length-delimited field")
             val = buf[pos:pos + ln]
             pos += ln
         elif wt == 5:      # fixed32
+            if pos + 4 > end:
+                raise ValueError("truncated fixed32")
             val = buf[pos:pos + 4]
             pos += 4
         elif wt == 1:      # fixed64
+            if pos + 8 > end:
+                raise ValueError("truncated fixed64")
             val = buf[pos:pos + 8]
             pos += 8
         else:              # groups — not used by xplane.proto

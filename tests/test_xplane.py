@@ -190,3 +190,25 @@ class TestStepProfilerIntegration:
         assert culprits
         assert "slowest collectives" in culprits[0].detail
         assert "all-reduce" in culprits[0].detail
+
+
+class TestParserRobustness:
+    def test_corrupt_pb_file_is_skipped(self, tmp_path):
+        """A torn/foreign .xplane.pb must not kill the profile publish."""
+        run = tmp_path / "plugins" / "profile" / "2026_01_01"
+        run.mkdir(parents=True)
+        (run / "host.xplane.pb").write_bytes(b"\xff\xfe\xfd garbage")
+        assert parse_trace_dir(str(tmp_path)) is None
+
+    def test_empty_trace_dir(self, tmp_path):
+        assert parse_trace_dir(str(tmp_path)) is None
+
+    def test_truncated_varint_rejected_cleanly(self, tmp_path):
+        run = tmp_path / "plugins" / "profile" / "r"
+        run.mkdir(parents=True)
+        # field 1, wire type 2, length 100 but no payload → the reader's
+        # bounds check raises ValueError (a silent short slice would
+        # misparse the corrupt file as an empty plane), caught per-file
+        # by parse_trace_dir
+        (run / "h.xplane.pb").write_bytes(b"\x0a\x64")
+        assert parse_trace_dir(str(tmp_path)) is None
