@@ -499,6 +499,61 @@ class TestPipelineTraining:
             losses.append(float(m["loss"]))
         assert losses[-1] < losses[0], losses
 
+    def test_pp_sp_gspmd_composes(self):
+        """Sequence parallel in gspmd mode (XLA-inserted collectives)
+        composes with the pipeline — only ring/ulysses are rejected."""
+        cfg = dataclasses.replace(GPTConfig.nano(), remat=False,
+                                  use_flash_attention=False,
+                                  dtype=jnp.float32)
+        res = auto_accelerate(
+            GPT(cfg), optimizer=optax.adam(1e-2),
+            strategy=[("pipeline_parallel", {"size": 2,
+                                             "microbatches": 2}),
+                      ("sequence_parallel", {"size": 2, "impl": "gspmd"}),
+                      ("fsdp", {})],
+            devices=jax.devices()[:8])
+        data = jax.random.randint(jax.random.PRNGKey(0), (8, 33), 0,
+                                  cfg.vocab_size)
+        batch = res.place_batch({"input_ids": data[:, :-1],
+                                 "labels": data[:, 1:]})
+        state, losses = res.state, []
+        for _ in range(4):
+            state, m = res.train_step(state, batch)
+            losses.append(float(m["loss"]))
+        assert losses[-1] < losses[0], losses
+
+    def test_pp_ring_sp_still_rejected(self):
+        cfg = dataclasses.replace(GPTConfig.nano(), remat=False)
+        with pytest.raises(ValueError, match="ring/ulysses"):
+            auto_accelerate(
+                GPT(cfg),
+                strategy=[("pipeline_parallel", {"size": 2}),
+                          ("sequence_parallel", {"size": 2,
+                                                 "impl": "ring"})],
+                devices=jax.devices()[:4])
+
+    def test_llama_trains_under_1f1b(self):
+        """The 1f1b value_and_grad path handles the Llama family (untied
+        embed/head key split) too."""
+        cfg = dataclasses.replace(LlamaConfig.nano(), remat=False,
+                                  use_flash_attention=False,
+                                  dtype=jnp.float32)
+        res = auto_accelerate(
+            Llama(cfg), optimizer=optax.adam(1e-2),
+            strategy=[("pipeline_parallel",
+                       {"size": 2, "microbatches": 2,
+                        "schedule": "1f1b"}), ("fsdp", {})],
+            devices=jax.devices()[:4])
+        data = jax.random.randint(jax.random.PRNGKey(1), (8, 33), 0,
+                                  cfg.vocab_size)
+        batch = res.place_batch({"input_ids": data[:, :-1],
+                                 "labels": data[:, 1:]})
+        state, losses = res.state, []
+        for _ in range(4):
+            state, m = res.train_step(state, batch)
+            losses.append(float(m["loss"]))
+        assert losses[-1] < losses[0], losses
+
     def test_moe_pp_ep_composes(self):
         """Expert parallelism composes with the pipeline: experts shard
         over ep inside the stage while layers shard over pp."""
