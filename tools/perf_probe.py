@@ -1,0 +1,314 @@
+"""On-chip step decomposition probe (axon tunnel: no per-op traces).
+
+Times the bench step's components in isolation on the real TPU so kernel
+work targets the measured-largest bucket instead of guesses.  Sync follows
+the bench.py rules (host readback; chain iterations on carried values —
+`block_until_ready` is a no-op over the tunnel).
+
+Usage: python tools/perf_probe.py [attn|attn_sweep|head|model|opt|step|lib] ...
+(no args = step/attn/head/model/opt).  One JSON line per probe.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+B, H, T, D = 24, 12, 1024, 64
+E = H * D
+VOCAB = 50304
+
+
+def _sync(x):
+    leaf = jax.tree.leaves(x)[0]
+    return float(jnp.float32(leaf.reshape(-1)[0]))
+
+
+def _time(fn, arg, iters=20, warmup=3):
+    """fn(arg) -> same-structured arg (chained); returns seconds/iter."""
+    for _ in range(warmup):
+        arg = fn(arg)
+    _sync(arg)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        arg = fn(arg)
+    _sync(arg)
+    return (time.perf_counter() - t0) / iters
+
+
+def _emit(name, ms, **extra):
+    print(json.dumps({"probe": name, "ms": round(ms * 1e3, 3), **extra}),
+          flush=True)
+
+
+def _qkv(key=0):
+    ks = jax.random.split(jax.random.PRNGKey(key), 3)
+    q = jax.random.normal(ks[0], (B, H, T, D), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (B, H, T, D), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (B, H, T, D), jnp.bfloat16)
+    return q, k, v
+
+
+INNER = 8  # dependent inner repeats per jit call: amortizes the ~5-8ms
+# per-dispatch tunnel overhead that otherwise dominates sub-20ms probes
+
+
+def probe_attn(block_q=1024, block_k=1024, tag="attn"):
+    from dlrover_wuqiong_tpu.ops.flash_attention import flash_attention
+
+    q, k, v = _qkv()
+
+    fa = functools.partial(flash_attention, causal=True, sm_scale=None,
+                           block_q=block_q, block_k=block_k)
+
+    @jax.jit
+    def fwd(args):
+        q, k, v = args
+        for _ in range(INNER):
+            q = fa(q, k, v)
+        return (q, k, v)
+
+    @jax.jit
+    def fwdbwd(args):
+        q, k, v = args
+
+        def loss(q, k, v):
+            return fa(q, k, v).astype(jnp.float32).sum()
+
+        for _ in range(INNER):
+            dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+            q, k, v = (dq.astype(q.dtype), dk.astype(k.dtype),
+                       dv.astype(v.dtype))
+        return (q, k, v)
+
+    t_f = _time(fwd, (q, k, v), iters=5) / INNER
+    t_fb = _time(fwdbwd, (q, k, v), iters=5) / INNER
+    # ideal: fwd 2 matmuls, bwd 5 matmuls of 2*B*H*T*T*D flops each
+    mm = 2 * B * H * T * T * D
+    _emit(tag, t_fb, fwd_ms=round(t_f * 1e3, 3),
+          blocks=[block_q, block_k],
+          ideal_fwd_ms=round(2 * mm / 155e12 * 1e3, 2),
+          ideal_fwdbwd_ms=round(7 * mm / 155e12 * 1e3, 2))
+
+
+def probe_attn_sweep():
+    for bq, bk in [(1024, 1024), (512, 1024), (512, 512), (256, 512),
+                   (256, 256), (128, 128)]:
+        probe_attn(bq, bk, tag=f"attn_{bq}x{bk}")
+
+
+def probe_lib():
+    """jax's bundled TPU flash kernel at the same shape — reference point."""
+    try:
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            BlockSizes,
+            flash_attention as jax_fa,
+        )
+    except ImportError as e:
+        print(json.dumps({"probe": "lib", "error": repr(e)}), flush=True)
+        return
+    q, k, v = _qkv()
+    bs = BlockSizes(block_q=512, block_k_major=512, block_k=512,
+                    block_b=1,
+                    block_q_major_dkv=512, block_k_major_dkv=512,
+                    block_k_dkv=512, block_q_dkv=512,
+                    block_k_major_dq=512, block_k_dq=512, block_q_dq=512)
+
+    @jax.jit
+    def fwd(args):
+        q, k, v = args
+        for _ in range(INNER):
+            q = jax_fa(q, k, v, causal=True, sm_scale=1.0,
+                       block_sizes=bs).astype(q.dtype)
+        return (q, k, v)
+
+    @jax.jit
+    def fwdbwd(args):
+        q, k, v = args
+
+        def loss(q, k, v):
+            return jax_fa(q, k, v, causal=True, sm_scale=1.0,
+                          block_sizes=bs).astype(jnp.float32).sum()
+
+        for _ in range(INNER):
+            dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+            q, k, v = (dq.astype(q.dtype), dk.astype(k.dtype),
+                       dv.astype(v.dtype))
+        return (q, k, v)
+
+    try:
+        t_f = _time(fwd, (q, k, v), iters=5) / INNER
+        t_fb = _time(fwdbwd, (q, k, v), iters=5) / INNER
+        _emit("lib_flash", t_fb, fwd_ms=round(t_f * 1e3, 3))
+    except Exception as e:  # noqa: BLE001
+        print(json.dumps({"probe": "lib", "error": repr(e)[:300]}),
+              flush=True)
+
+
+def probe_head():
+    """LM head + CE fwd+bwd: x (B,T,E) @ wte (V,E)^T -> ce."""
+    from dlrover_wuqiong_tpu.models.gpt import cross_entropy_loss
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (B, T, E), jnp.bfloat16)
+    wte = jax.random.normal(jax.random.PRNGKey(1), (VOCAB, E), jnp.float32)
+    tgt = jax.random.randint(jax.random.PRNGKey(2), (B, T), 0, VOCAB)
+
+    @jax.jit
+    def fwdbwd(args):
+        x, wte = args
+
+        def loss(x, wte):
+            logits = jnp.einsum("bte,ve->btv", x, wte.astype(x.dtype))
+            return cross_entropy_loss(logits, tgt)
+
+        for _ in range(INNER):
+            dx, dw = jax.grad(loss, argnums=(0, 1))(x, wte)
+            x, wte = dx.astype(x.dtype), dw
+        return (x, wte)
+
+    t = _time(fwdbwd, (x, wte), iters=5) / INNER
+    mm = 2 * B * T * E * VOCAB
+    _emit("head_ce", t, ideal_ms=round(3 * mm / 155e12 * 1e3, 2))
+
+
+def probe_model():
+    """Full model fwd (no CE) and fwd+bwd with sum loss (no head)."""
+    import dataclasses
+
+    from dlrover_wuqiong_tpu.models.gpt import GPT, GPTConfig
+
+    cfg = dataclasses.replace(GPTConfig.gpt2(), remat=False)
+    model = GPT(cfg)
+    params = model.init_params(jax.random.PRNGKey(0), batch=1, seq=T)
+    idx = jax.random.randint(jax.random.PRNGKey(1), (B, T), 0,
+                             cfg.vocab_size)
+
+    @jax.jit
+    def fwd(params):
+        h = model.apply({"params": params}, idx, return_hidden=True)[1]
+        # consume hidden so the head matmul isn't in this probe
+        return jax.tree.map(
+            lambda p: p + 0 * h.astype(jnp.float32).mean().astype(p.dtype)
+            if p.ndim else p, params)
+
+    @jax.jit
+    def fwdbwd(params):
+        def loss(p):
+            h = model.apply({"params": p}, idx, return_hidden=True)[1]
+            return h.astype(jnp.float32).sum()
+
+        g = jax.grad(loss)(params)
+        return g
+
+    t_f = _time(fwd, params)
+    t_fb = _time(fwdbwd, params)
+    _emit("model_no_head", t_fb, fwd_ms=round(t_f * 1e3, 3))
+
+
+def probe_opt():
+    import optax
+
+    from dlrover_wuqiong_tpu.models.gpt import GPT, GPTConfig
+
+    cfg = GPTConfig.gpt2()
+    params = GPT(cfg).init_params(jax.random.PRNGKey(0), batch=1, seq=8)
+    opt = optax.adamw(3e-4)
+    state = opt.init(params)
+
+    @jax.jit
+    def upd(args):
+        params, state = args
+        for _ in range(INNER):
+            grads = jax.tree.map(lambda p: p * 1e-3, params)
+            updates, state = opt.update(grads, state, params)
+            params = optax.apply_updates(params, updates)
+        return (params, state)
+
+    t = _time(upd, (params, state), iters=5) / INNER
+    _emit("optimizer", t)
+
+
+def probe_step():
+    import dataclasses
+
+    import optax
+
+    from dlrover_wuqiong_tpu.auto.accelerate import auto_accelerate
+    from dlrover_wuqiong_tpu.models.gpt import GPT, GPTConfig
+
+    cfg = dataclasses.replace(GPTConfig.gpt2(), remat=False)
+    res = auto_accelerate(GPT(cfg), optimizer=optax.adamw(3e-4),
+                          devices=jax.devices()[:1], strategy=[("fsdp", {})])
+    data = jax.random.randint(jax.random.PRNGKey(0), (B, T + 1), 0,
+                              cfg.vocab_size)
+    b = res.place_batch({"input_ids": data[:, :-1], "labels": data[:, 1:]})
+
+    def stepper(state):
+        state, _ = res.train_step(state, b)
+        return state
+
+    t = _time(stepper, jax.tree.map(jnp.copy, res.state))
+    _emit("full_step", t)
+
+
+def probe_splash():
+    """jax splash-attention (newer vmapped MQA-style kernel) — causal."""
+    try:
+        from jax.experimental.pallas.ops.tpu.splash_attention import (
+            splash_attention_kernel as sk,
+            splash_attention_mask as sm,
+        )
+    except ImportError as e:
+        print(json.dumps({"probe": "splash", "error": repr(e)}), flush=True)
+        return
+    q, k, v = _qkv()
+    mask = sm.MultiHeadMask(
+        [sm.CausalMask((T, T)) for _ in range(H)])
+    kernel = sk.make_splash_mha(mask, head_shards=1, q_seq_shards=1)
+
+    @jax.jit
+    def fwd(args):
+        q, k, v = args
+        for _ in range(INNER):
+            q = jax.vmap(kernel)(q, k, v).astype(q.dtype)
+        return (q, k, v)
+
+    @jax.jit
+    def fwdbwd(args):
+        q, k, v = args
+
+        def loss(q, k, v):
+            return jax.vmap(kernel)(q, k, v).astype(jnp.float32).sum()
+
+        for _ in range(INNER):
+            dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+            q, k, v = (dq.astype(q.dtype), dk.astype(k.dtype),
+                       dv.astype(v.dtype))
+        return (q, k, v)
+
+    try:
+        t_f = _time(fwd, (q, k, v), iters=5) / INNER
+        t_fb = _time(fwdbwd, (q, k, v), iters=5) / INNER
+        _emit("splash", t_fb, fwd_ms=round(t_f * 1e3, 3))
+    except Exception as e:  # noqa: BLE001
+        print(json.dumps({"probe": "splash", "error": repr(e)[:300]}),
+              flush=True)
+
+
+ALL = {"attn": probe_attn, "attn_sweep": probe_attn_sweep, "lib": probe_lib,
+       "splash": probe_splash,
+       "head": probe_head, "model": probe_model, "opt": probe_opt,
+       "step": probe_step}
+
+if __name__ == "__main__":
+    names = sys.argv[1:] or ["step", "attn", "head", "model", "opt"]
+    for n in names:
+        ALL[n]()
