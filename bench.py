@@ -207,19 +207,22 @@ def main():
     }))
 
 
+def _bench_produce(vocab, batch, seq, worker_id, step):
+    """Module-level so the SPAWNED coworkers can unpickle it."""
+    import numpy as np
+
+    rng = np.random.default_rng(worker_id * 100_003 + step)
+    x = rng.integers(0, vocab, (batch, seq + 1), dtype=np.int32)
+    return {"input_ids": x[:, :-1], "labels": x[:, 1:]}
+
+
 def _real_input_run(res, state, cfg, batch, seq, steps):
     """Throughput with the shm coworker loader feeding every step."""
-    import numpy as np
+    import functools
 
     from dlrover_wuqiong_tpu.data.shm_loader import ShmCoworkerLoader
 
-    vocab = cfg.vocab_size
-
-    def produce(worker_id, step):
-        rng = np.random.default_rng(worker_id * 100_003 + step)
-        x = rng.integers(0, vocab, (batch, seq + 1), dtype=np.int32)
-        return {"input_ids": x[:, :-1], "labels": x[:, 1:]}
-
+    produce = functools.partial(_bench_produce, cfg.vocab_size, batch, seq)
     example = produce(0, 0)
     loader = ShmCoworkerLoader(produce, example, num_workers=2, depth=4,
                                max_steps=steps + 2)

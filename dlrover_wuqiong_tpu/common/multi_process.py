@@ -307,7 +307,21 @@ class SharedMemoryBuffer:
         self._shm.close()
 
     def unlink(self):
+        # CPython 3.12's SharedMemory.unlink() unconditionally UNregisters
+        # the segment from the resource tracker — but __init__ already
+        # unregistered it (by design, see _unregister), so the tracker
+        # process would log a KeyError traceback.  Re-register first so the
+        # pair balances.
+        try:
+            resource_tracker.register(self._shm._name, "shared_memory")
+        except Exception:  # noqa: BLE001 — impl detail of CPython
+            pass
         try:
             self._shm.unlink()
         except FileNotFoundError:
-            pass
+            # already unlinked by a peer: CPython skipped ITS unregister,
+            # so balance the register above or the tracker warns at exit
+            try:
+                resource_tracker.unregister(self._shm._name, "shared_memory")
+            except Exception:  # noqa: BLE001
+                pass

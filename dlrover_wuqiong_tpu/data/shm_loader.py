@@ -100,9 +100,11 @@ class ShmCoworkerLoader:
     """Iterate batches produced by coworker processes through shm.
 
     produce_fn(worker_id, step) -> {name: np.ndarray} with shapes/dtypes
-    matching `example_batch` (slots are sized once from it).  Batches are
-    yielded in READY order, not step order (parity: the reference's
-    unordered dataloader) — pass num_workers=1 for strict ordering.
+    matching `example_batch` (slots are sized once from it); it must be
+    PICKLABLE (module-level function or functools.partial of one) because
+    coworkers are spawned, not forked.  Batches are yielded in READY order,
+    not step order (parity: the reference's unordered dataloader) — pass
+    num_workers=1 for strict ordering.
     """
 
     def __init__(self, produce_fn: Callable,
@@ -122,8 +124,14 @@ class ShmCoworkerLoader:
         for i in range(depth):
             self._free_q.put(i)
         self._inflight_slot: Optional[int] = None
+        # SPAWN, not fork: the consumer is typically a JAX-initialized
+        # (multithreaded) process — fork from it is a documented deadlock
+        # (os.fork RuntimeWarning in the r3 bench tail).  Spawn requires
+        # produce_fn to be picklable: a module-level function or a
+        # functools.partial of one, never a closure.
+        ctx = multiprocessing.get_context("spawn")
         self._procs = [
-            multiprocessing.Process(
+            ctx.Process(
                 target=_producer_main,
                 args=(self.job_name, w, num_workers, produce_fn, max_steps),
                 daemon=True)
