@@ -232,9 +232,10 @@ class ElasticAgent:
         stdout = None
         if self.config.log_dir:
             os.makedirs(self.config.log_dir, exist_ok=True)
+            prune_prefix = f"worker_{self.node_rank}_"
             log_path = os.path.join(
                 self.config.log_dir,
-                f"worker_{self.node_rank}_r{self._restart_count}.log")
+                f"{prune_prefix}r{self._restart_count}.log")
             stdout = open(log_path, "ab")
             stderr = subprocess.STDOUT
         else:
@@ -244,9 +245,9 @@ class ElasticAgent:
 
             log_dir = os.path.join(tempfile.gettempdir(), "dwt-worker-logs")
             os.makedirs(log_dir, exist_ok=True)
+            prune_prefix = f"worker_{os.getpid()}_{self.node_rank}_"
             log_path = os.path.join(
-                log_dir, f"worker_{os.getpid()}_{self.node_rank}_"
-                         f"r{self._restart_count}.stderr")
+                log_dir, f"{prune_prefix}r{self._restart_count}.stderr")
             stderr = open(log_path, "ab")
         proc = subprocess.Popen(
             self.entrypoint, env=env, stdout=stdout, stderr=stderr,
@@ -256,7 +257,8 @@ class ElasticAgent:
         for fh in (stdout, stderr):
             if hasattr(fh, "close"):
                 fh.close()
-        self._prune_worker_logs(os.path.dirname(log_path), keep=5)
+        self._prune_worker_logs(os.path.dirname(log_path), prune_prefix,
+                                keep=5)
         logger.info("launched worker pid=%d process_id=%d/%d coord=%s "
                     "(log %s)", proc.pid, outcome.process_id,
                     outcome.num_processes, outcome.coordinator_addr,
@@ -265,13 +267,15 @@ class ElasticAgent:
                              outcome.num_processes, self._restart_count,
                              log_path=log_path)
 
-    def _prune_worker_logs(self, log_dir: str, keep: int = 5):
+    def _prune_worker_logs(self, log_dir: str, prefix: str, keep: int = 5):
         """Cap this agent's per-restart worker logs (oldest deleted).
 
-        Ordered by mtime, NOT filename — lexicographic sort would rank
-        r10 before r2 and delete the newest logs once restarts hit 10."""
+        `prefix` comes from the launch site so it always matches the active
+        naming scheme (config.log_dir files have no pid component — a
+        hardcoded pid prefix silently never pruned them).  Ordered by
+        mtime, NOT filename — lexicographic sort would rank r10 before r2
+        and delete the newest logs once restarts hit 10."""
         try:
-            prefix = f"worker_{os.getpid()}_{self.node_rank}_"
             mine = sorted(
                 (f for f in os.listdir(log_dir) if f.startswith(prefix)),
                 key=lambda f: os.path.getmtime(os.path.join(log_dir, f)))

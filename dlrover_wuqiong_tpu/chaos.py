@@ -127,9 +127,15 @@ def pod_kill(kill_at_step: int = 8, total_steps: int = 20,
                 killed_pid = int(open(os.path.join(marker, "pid_r0"))
                                  .read())
                 os.kill(killed_pid, signal.SIGKILL)  # the chaosblade moment
-                killed_at = seen
+                # TOCTOU: the worker can advance past `seen` (and
+                # checkpoint) before the SIGKILL lands — the worker is dead
+                # NOW, so the file holds the final authoritative step
+                try:
+                    killed_at = int(open(progress).read())
+                except (OSError, ValueError):
+                    killed_at = seen
                 logger.info("pod-kill: SIGKILL worker pid=%d at step %d",
-                            killed_pid, seen)
+                            killed_pid, killed_at)
         except (OSError, ValueError):
             pass
         time.sleep(0.05)

@@ -26,6 +26,8 @@ from __future__ import annotations
 
 import base64
 import threading
+import time
+from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
@@ -73,7 +75,15 @@ class EmbeddingShardServer:
         # of a second gradient application.  Read ops (gather/stats) are
         # safe to re-execute (a gather replay at worst re-bumps frequency
         # once) and their row payloads are too large to cache.
-        self._applied: Dict[str, Dict[int, Dict]] = {}
+        # The client axis is bounded too: every worker restart mints a
+        # fresh client uuid, so an unbounded dict grows one dead cache per
+        # restart on a long-lived shard server.  Eviction is IDLE-TIME
+        # based (a client idle past the RPC retry horizon never replays) —
+        # a fixed count cap would evict live clients on large fleets and
+        # silently re-enable the double-apply bug this cache prevents.
+        self._applied: "OrderedDict[str, Tuple[float, Dict[int, Dict]]]" = \
+            OrderedDict()
+        self._client_idle_horizon = 300.0  # seconds, >> RPC retry window
         self._server = RpcServer(self._handle, host=host, port=port)
         if advertise_host is None:
             if host in ("0.0.0.0", "::", ""):
@@ -105,7 +115,15 @@ class EmbeddingShardServer:
         mutating = op in ("emb_grads", "emb_advance_epoch")
         with self._lock:
             if mutating and client is not None and seq is not None:
-                cache = self._applied.setdefault(client, {})
+                now = time.monotonic()
+                _, cache = self._applied.setdefault(client, (now, {}))
+                self._applied[client] = (now, cache)
+                self._applied.move_to_end(client)  # keep idle-ordered
+                while self._applied:
+                    ts, _ = next(iter(self._applied.values()))
+                    if now - ts <= self._client_idle_horizon:
+                        break
+                    self._applied.popitem(last=False)
                 if seq in cache:
                     return cache[seq]  # retry replay — do not re-apply
                 resp = self._execute(op, payload)

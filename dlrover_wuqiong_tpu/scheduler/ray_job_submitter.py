@@ -98,6 +98,10 @@ class RayJobSubmitter:
             if stream_logs:
                 try:
                     text = self.logs()
+                    # the Jobs API log is nominally append-only, but
+                    # rotation/truncation can shrink it — clamp so the
+                    # slice below never re-prints from a negative index
+                    printed = min(printed, len(text))
                     if len(text) > printed:
                         sys.stdout.write(text[printed:])
                         sys.stdout.flush()

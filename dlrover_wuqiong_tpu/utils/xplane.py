@@ -7,7 +7,8 @@ exports per-op latency gauges to Prometheus.
 TPU redesign: device kernels are not host-visible calls, so instead of
 hooking launches we parse the XPlane protobuf that `jax.profiler` drops for
 a traced step window and aggregate device-op durations by category (matmul,
-collective, transfer, fused, sync, other).  The profile feeds the shared
+collective, transfer, data-movement (on-device dynamic-slice/gather/...),
+fused, sync, other).  The profile feeds the shared
 MetricRegistry (→ PrometheusExporter) and the diagnosis evidence chain
 (top-k slowest collectives), giving the same observability surface without
 a preload shim.
@@ -182,8 +183,12 @@ _PREFIX_CATEGORIES = (
                     "reduce-scatter", "collective-permute",
                     "collective-broadcast", "ragged-all-to-all")),
     ("matmul", ("dot", "convolution", "ragged-dot", "cublas", "gemm")),
-    ("transfer", ("copy", "infeed", "outfeed", "send", "recv",
-                  "dynamic-update-slice", "dynamic-slice")),
+    # dynamic-(update-)slice is ON-DEVICE data movement, heavily emitted by
+    # the scan-based pipeline schedules — bucketing it under "transfer"
+    # would inflate the host<->device gauge for every pipelined job
+    ("transfer", ("copy", "infeed", "outfeed", "send", "recv")),
+    ("data-movement", ("dynamic-update-slice", "dynamic-slice", "gather",
+                       "scatter", "reshape", "transpose")),
     ("sync", ("rendezvous", "wait")),
     ("fused", ("fusion", "loop_", "input_", "output_")),
 )
