@@ -34,7 +34,7 @@ from ..trainer.train_step import (
     TrainState,
     make_lm_loss,
     make_train_step,
-    shard_train_state,
+    train_state_shardings,
 )
 
 logger = get_logger("accelerate")
@@ -340,11 +340,15 @@ def auto_accelerate(
                     f" v={pp_virtual}" if pp_virtual > 1 else "")
 
     rng = rng if rng is not None else jax.random.PRNGKey(0)
-    params = model.init_params(rng)
     optimizer = optimizer or optax.adamw(3e-4)
     loss = loss_fn or make_lm_loss(model.apply)
 
     if ctx.extra.get("local_sgd") is not None:
+        # params sharded by construction (same mechanism as below); the
+        # DiLoCo state builder then derives its outer/inner trees from them
+        p_abs = jax.eval_shape(model.init_params, rng)
+        p_sh = planner.param_shardings(p_abs)
+        params = jax.jit(model.init_params, out_shardings=p_sh)(rng)
         # DiLoCo two-level training (parallel/local_sgd.py): the dp axis
         # becomes the replica-group axis that only syncs every H steps
         from ..parallel.local_sgd import (
@@ -371,8 +375,20 @@ def auto_accelerate(
                     " reduce=%s", ctx.plan.dp, ls_cfg.sync_every,
                     ls_cfg.reduce)
     else:
-        state = TrainState.create(params, optimizer)
-        state, state_sh = shard_train_state(state, planner)
+        # Sharded-by-construction init (parity: reference meta-device init
+        # + deferred materialization, atorch/utils/meta_model_utils.py:759
+        # and fsdp_init_util.py:502): eval_shape infers the full train-state
+        # tree WITHOUT allocating it, the planner maps shardings onto the
+        # abstract tree, and jit-with-out_shardings materializes each
+        # parameter/optimizer shard directly on its owner device.  No
+        # process ever holds the unsharded 8B tree the old eager
+        # `model.init_params(rng)` + device_put path required.
+        def _create_state(r):
+            return TrainState.create(model.init_params(r), optimizer)
+
+        abstract = jax.eval_shape(_create_state, rng)
+        state_sh = train_state_shardings(abstract, planner)
+        state = jax.jit(_create_state, out_shardings=state_sh)(rng)
         vg_fn = None
         if ctx.plan.pp > 1 and ctx.extra.get("pp_schedule") == "1f1b":
             # manual fwd/bwd interleave replaces autodiff-through-apply

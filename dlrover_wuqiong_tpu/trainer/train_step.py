@@ -86,7 +86,23 @@ def make_train_step(
 
 def shard_train_state(state: TrainState, planner: ShardingPlanner
                       ) -> Tuple[TrainState, Any]:
-    """Place params/opt-state on the mesh; returns (state, state_shardings)."""
+    """Place params/opt-state on the mesh; returns (state, state_shardings).
+
+    Prefer `train_state_shardings` + jit-with-out_shardings init (see
+    auto/accelerate.py) for new code: this entry materializes the full
+    unsharded tree first, which an 8B-class model cannot afford."""
+    state_sh = train_state_shardings(state, planner)
+    placed = jax.device_put(state, state_sh)
+    return placed, state_sh
+
+
+def train_state_shardings(state_like: TrainState, planner: ShardingPlanner
+                          ) -> TrainState:
+    """Shardings for a TrainState, from a concrete OR abstract
+    (jax.eval_shape) instance — never touches leaf values, so the full
+    tree need not exist (sharded-by-construction init, parity
+    atorch/utils/meta_model_utils.py:759 deferred materialization)."""
+    state = state_like
     param_sh = planner.param_shardings(state.params)
     repl = planner.replicated()
 
@@ -114,9 +130,7 @@ def shard_train_state(state: TrainState, planner: ShardingPlanner
         lambda sub: (param_sh if _is_param_shaped(sub)
                      else jax.tree.map(lambda _: repl, sub)),
         state.opt_state, is_leaf=_is_param_shaped)
-    state_sh = TrainState(step=repl, params=param_sh, opt_state=opt_sh)
-    placed = jax.device_put(state, state_sh)
-    return placed, state_sh
+    return TrainState(step=repl, params=param_sh, opt_state=opt_sh)
 
 
 def make_lm_loss(model_apply: Callable) -> Callable:

@@ -291,3 +291,64 @@ class TestAutoAccelerate:
     def test_unknown_strategy_raises(self):
         with pytest.raises(ValueError, match="unknown optimization"):
             resolve_strategy([("warp_drive", {})], 8)
+
+
+class TestShardedByConstructionInit:
+    """Sharded-by-construction init (parity: reference meta-device init,
+    atorch/utils/meta_model_utils.py + fsdp_init_util.py): auto_accelerate
+    must never materialize the full unsharded train-state tree — params and
+    optimizer moments are jit-initialized straight into their shards."""
+
+    def _per_device_bytes(self, state):
+        per_dev = {}
+        for leaf in jax.tree.leaves(state):
+            for sh in leaf.addressable_shards:
+                per_dev[sh.device] = per_dev.get(sh.device, 0) + \
+                    sh.data.nbytes
+        return per_dev
+
+    def test_fsdp_state_is_partitioned_not_replicated(self):
+        cfg = GPTConfig(vocab_size=2048, n_layer=2, n_head=4, n_embd=256,
+                        block_size=128)
+        res = auto_accelerate(GPT(cfg), optimizer=optax.adamw(1e-3),
+                              strategy=[("fsdp", {})])
+        total = sum(leaf.nbytes for leaf in jax.tree.leaves(res.state))
+        per_dev = self._per_device_bytes(res.state)
+        assert len(per_dev) == 8
+        # fully replicated would be ~total per device; sharded-by-
+        # construction must land near total/8 (+ replicated scalars/biases)
+        worst = max(per_dev.values())
+        assert worst < total * 0.25, (
+            f"device holds {worst} of {total} bytes — state is (near-)"
+            "replicated, not sharded by construction")
+        # optimizer moments follow the param shardings
+        mu = res.state.opt_state[0].mu["wte"]["embedding"]
+        p = res.state.params["wte"]["embedding"]
+        assert mu.sharding == p.sharding
+        assert not p.sharding.is_fully_replicated
+
+    def test_jit_init_matches_eager_init(self):
+        cfg = GPTConfig.nano()
+        model = GPT(cfg)
+        rng = jax.random.PRNGKey(7)
+        res = auto_accelerate(model, optimizer=optax.sgd(1e-2),
+                              strategy=[("fsdp", {})], rng=rng)
+        eager = model.init_params(rng)
+        # same PRNG stream (partitionable threefry), tiny tolerance for
+        # jit-fusion rounding (~3e-8 measured on the initializer scaling)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+            eager, dict(res.state.params))
+
+    def test_tp_fsdp_composed_init_shards_both_axes(self):
+        cfg = GPTConfig(vocab_size=1024, n_layer=2, n_head=4, n_embd=256,
+                        block_size=128)
+        res = auto_accelerate(
+            GPT(cfg), optimizer=optax.adamw(1e-3),
+            strategy=[("tensor_parallel", {"size": 2}), ("fsdp", {})])
+        p = res.state.params["h_0"]["mlp"]["c_fc"]["kernel"]
+        assert not p.sharding.is_fully_replicated
+        total = sum(leaf.nbytes for leaf in jax.tree.leaves(res.state))
+        worst = max(self._per_device_bytes(res.state).values())
+        assert worst < total * 0.3
