@@ -72,6 +72,10 @@ class StrategyContext:
             out["dtype"] = jnp.bfloat16 if self.amp else jnp.float32
         if self.remat is not None and "remat" in fields:
             out["remat"] = self.remat
+        if self.extra.get("remat_policy") and "remat_policy" in fields:
+            out["remat_policy"] = self.extra["remat_policy"]
+        if self.extra.get("remat_names") and "remat_names" in fields:
+            out["remat_names"] = self.extra["remat_names"]
         if self.flash_attention is not None and \
                 "use_flash_attention" in fields:
             out["use_flash_attention"] = self.flash_attention
@@ -158,7 +162,19 @@ def _s_amp(ctx: StrategyContext, cfg: Dict, num_devices: int):
 
 @register_strategy("checkpoint")
 def _s_ckpt(ctx: StrategyContext, cfg: Dict, num_devices: int):
+    """cfg: enabled, policy ("full" | "dots" | "offload_dots" |
+    "save_names" | "offload_names"), names (checkpoint_name anchors for
+    the *_names policies).  Parity: reference selective_offloading_
+    checkpoint.py + activation_checkpointing.py; policies resolved in
+    ops/remat.py."""
     ctx.remat = cfg.get("enabled", True)
+    if cfg.get("policy") is not None:
+        from ..ops.remat import resolve_remat_policy
+
+        resolve_remat_policy(cfg["policy"])  # fail fast on a bad name
+        ctx.extra["remat_policy"] = cfg["policy"]
+    if cfg.get("names"):
+        ctx.extra["remat_names"] = tuple(cfg["names"])
 
 
 @register_strategy("module_replace")

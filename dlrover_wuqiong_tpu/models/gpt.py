@@ -30,6 +30,14 @@ class GPTConfig:
     dropout: float = 0.0
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # jax.checkpoint policy when remat is on: "full" (recompute all) |
+    # "dots" (save matmul outputs) | "offload_dots" (matmul outputs ->
+    # pinned host) | "save_names"/"offload_names" (the attn_out/mlp_out
+    # checkpoint_name annotations) — ops/remat.py
+    remat_policy: str = "full"
+    # checkpoint_name anchors for the *_names policies; () = the models'
+    # built-in ("attn_out", "mlp_out")
+    remat_names: tuple = ()
     use_flash_attention: bool = True
     attn_impl: str = "flash"  # "flash" | "ring" | "ulysses"
     mesh: Any = None  # required by ring/ulysses (set by auto_accelerate)
@@ -128,9 +136,15 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, deterministic: bool = True):
+        from jax.ad_checkpoint import checkpoint_name
+
         cfg = self.config
-        x = x + CausalSelfAttention(cfg, name="attn")(
+        # checkpoint_name marks the save/offload anchors for the
+        # "save_names"/"offload_names" remat policies (ops/remat.py);
+        # identity under every other policy
+        attn = CausalSelfAttention(cfg, name="attn")(
             nn.LayerNorm(dtype=cfg.dtype, name="ln_1")(x), deterministic)
+        x = x + checkpoint_name(attn, "attn_out")
         if cfg.moe_experts:
             from .moe import MoEConfig, MoEMLP
 
@@ -139,11 +153,11 @@ class Block(nn.Module):
                                    top_k=cfg.moe_top_k,
                                    capacity_factor=cfg.moe_capacity_factor,
                                    dtype=cfg.dtype), name="moe_mlp")
-            x = x + mlp(nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x))
+            h = mlp(nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x))
         else:
-            x = x + MLP(cfg, name="mlp")(
+            h = MLP(cfg, name="mlp")(
                 nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x), deterministic)
-        return x
+        return x + checkpoint_name(h, "mlp_out")
 
 
 class GPT(nn.Module):
@@ -161,7 +175,20 @@ class GPT(nn.Module):
         x = tok + pos
         block = Block
         if cfg.remat:
-            block = nn.remat(Block, prevent_cse=False)
+            from ..ops.remat import resolve_remat_policy
+
+            # prevent_cse=True: the layers run in a python loop (not
+            # scan), and without the CSE barrier XLA merges the
+            # rematerialized forward back into the saved one — measured on
+            # v5e as remat silently becoming a no-op (identical step time
+            # AND activation temps with remat on/off)
+            from ..ops.remat import MODEL_CHECKPOINT_NAMES
+
+            block = nn.remat(
+                Block, prevent_cse=True,
+                policy=resolve_remat_policy(
+                    cfg.remat_policy,
+                    cfg.remat_names or MODEL_CHECKPOINT_NAMES))
         for i in range(cfg.n_layer):
             x = block(cfg, name=f"h_{i}")(x, deterministic)
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)

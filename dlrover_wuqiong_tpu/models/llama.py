@@ -31,6 +31,8 @@ class LlamaConfig:
     rms_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    remat_policy: str = "full"  # ops/remat.py policy names
+    remat_names: tuple = ()  # () = built-in ("attn_out", "mlp_out")
     use_flash_attention: bool = True
     attn_impl: str = "flash"  # "flash" | "ring" | "ulysses"
     mesh: Any = None  # required by ring/ulysses (set by auto_accelerate)
@@ -159,12 +161,16 @@ class LlamaBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, cos, sin):
+        from jax.ad_checkpoint import checkpoint_name
+
         cfg = self.config
-        x = x + LlamaAttention(cfg, name="attention")(
+        # save/offload anchors for the *_names remat policies (ops/remat.py)
+        attn = LlamaAttention(cfg, name="attention")(
             RMSNorm(cfg.rms_eps, cfg.dtype, name="input_norm")(x), cos, sin)
-        x = x + LlamaMLP(cfg, name="feed_forward")(
+        x = x + checkpoint_name(attn, "attn_out")
+        h = LlamaMLP(cfg, name="feed_forward")(
             RMSNorm(cfg.rms_eps, cfg.dtype, name="post_attn_norm")(x))
-        return x
+        return x + checkpoint_name(h, "mlp_out")
 
 
 class Llama(nn.Module):
@@ -179,8 +185,17 @@ class Llama(nn.Module):
         cos, sin = rope_freqs(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
         block = LlamaBlock
         if cfg.remat:
-            block = nn.remat(LlamaBlock, prevent_cse=False,
-                             static_argnums=())
+            from ..ops.remat import resolve_remat_policy
+
+            # prevent_cse=True — see models/gpt.py: python-loop layers
+            # need the CSE barrier or XLA undoes the remat
+            from ..ops.remat import MODEL_CHECKPOINT_NAMES
+
+            block = nn.remat(
+                LlamaBlock, prevent_cse=True, static_argnums=(),
+                policy=resolve_remat_policy(
+                    cfg.remat_policy,
+                    cfg.remat_names or MODEL_CHECKPOINT_NAMES))
         for i in range(cfg.num_layers):
             x = block(cfg, name=f"layers_{i}")(x, cos, sin)
         x = RMSNorm(cfg.rms_eps, cfg.dtype, name="norm")(x)

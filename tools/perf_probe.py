@@ -303,7 +303,50 @@ def probe_splash():
               flush=True)
 
 
+def probe_remat():
+    """Step time + compiled HBM temp (activation) bytes per remat policy."""
+    import optax
+
+    from dlrover_wuqiong_tpu.auto.accelerate import auto_accelerate
+    from dlrover_wuqiong_tpu.models.gpt import GPT, GPTConfig
+
+    base = GPTConfig.gpt2()
+    data = jax.random.randint(jax.random.PRNGKey(0), (B, T + 1), 0,
+                              base.vocab_size)
+    for policy in [None, "full", "dots", "offload_dots"]:
+        strat = [("fsdp", {})]
+        if policy is None:
+            strat.append(("checkpoint", {"enabled": False}))
+        else:
+            strat.append(("checkpoint", {"policy": policy}))
+        try:
+            res = auto_accelerate(GPT(base), optimizer=optax.adamw(3e-4),
+                                  devices=jax.devices()[:1], strategy=strat)
+            b = res.place_batch({"input_ids": data[:, :-1],
+                                 "labels": data[:, 1:]})
+            lowered = jax.jit(
+                res.train_step._fun if hasattr(res.train_step, "_fun")
+                else res.train_step.__wrapped__,
+                donate_argnums=(0,)).lower(res.state, b)                 if False else res.train_step.lower(res.state, b)
+            compiled = lowered.compile()
+            mem = compiled.memory_analysis()
+            temp_gb = getattr(mem, "temp_size_in_bytes", 0) / 2**30
+
+            def stepper(state):
+                state, _ = res.train_step(state, b)
+                return state
+
+            t = _time(stepper, jax.tree.map(jnp.copy, res.state),
+                      iters=10, warmup=2)
+            _emit(f"remat_{policy}", t, temp_gb=round(temp_gb, 3))
+            del res
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({"probe": f"remat_{policy}",
+                              "error": repr(e)[:200]}), flush=True)
+
+
 ALL = {"attn": probe_attn, "attn_sweep": probe_attn_sweep, "lib": probe_lib,
+       "remat": probe_remat,
        "splash": probe_splash,
        "head": probe_head, "model": probe_model, "opt": probe_opt,
        "step": probe_step}
