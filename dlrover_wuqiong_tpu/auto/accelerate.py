@@ -321,7 +321,8 @@ def auto_accelerate(
             raise ValueError(
                 "pipeline_parallel does not compose with ring/ulysses "
                 "sequence parallel yet — use impl='gspmd' or drop one")
-        # (MoE x 1f1b is rejected by PipelinedLM.__post_init__ itself)
+        # (MoE composes with every schedule incl. 1f1b — the manual
+        # backward seeds the router aux cotangent, parallel/pipeline.py)
         n_layer = getattr(model.config, "n_layer",
                           getattr(model.config, "num_layers", None))
         if n_layer is None or n_layer % ctx.plan.pp:
@@ -380,12 +381,9 @@ def auto_accelerate(
                 "dp axis carries the locally-training replica groups")
         # (local_sgd x pipeline is rejected earlier, in the pp branch,
         # before any parameter initialization)
-        if ctx.accum_steps > 1:
-            raise ValueError("local_sgd does not compose with grad_accum "
-                             "yet")
         state = init_diloco_state(params, optimizer, mesh, planner, ls_cfg)
         step = make_diloco_train_step(loss, optimizer, mesh, planner,
-                                      ls_cfg)
+                                      ls_cfg, accum_steps=ctx.accum_steps)
         state_sh = jax.tree.map(lambda x: x.sharding, state)
         logger.info("local_sgd (DiLoCo): dp=%d groups, sync every %d steps,"
                     " reduce=%s", ctx.plan.dp, ls_cfg.sync_every,

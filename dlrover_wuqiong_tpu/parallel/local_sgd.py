@@ -80,12 +80,17 @@ def make_diloco_train_step(
     mesh: Mesh,
     planner: ShardingPlanner,
     cfg: LocalSGDConfig,
+    accum_steps: int = 1,
 ):
     """Returns jit'd `step(DiLoCoState, batch) -> (DiLoCoState, metrics)`.
 
     The batch is sharded over ("dp", "fsdp") as usual; each dp group trains
     its own inner replica on its batch shard and only the periodic outer
-    sync crosses the dp (DCN) axis.
+    sync crosses the dp (DCN) axis.  With `accum_steps > 1` the batch
+    carries a leading microbatch axis (replicated over dp) and gradients
+    accumulate INSIDE the inner step — the accumulation is entirely local
+    to each replica group, so it composes with the two-level scheme (the
+    round-3 local_sgd x grad_accum rejection, closed).
     """
     if _shard_map is None:  # pragma: no cover
         raise RuntimeError("local_sgd needs jax.shard_map")
@@ -105,7 +110,14 @@ def make_diloco_train_step(
               batch):
         p = _unstack(inner_params)
         o = _unstack(inner_opt)
-        loss, grads = jax.value_and_grad(loss_fn)(p, batch)
+        if accum_steps == 1:
+            loss, grads = jax.value_and_grad(loss_fn)(p, batch)
+        else:
+            from ..trainer.train_step import accumulate_grads
+
+            loss, grads = accumulate_grads(
+                lambda micro: jax.value_and_grad(loss_fn)(p, micro), p,
+                batch, accum_steps)
         updates, o = inner_optimizer.update(grads, o, p)
         p = optax.apply_updates(p, updates)
 
@@ -143,11 +155,13 @@ def make_diloco_train_step(
 
     # specs: stacked leaves map their leading axis to dp; the batch maps its
     # batch dim to dp so each group trains on ITS shard (fsdp stays auto
-    # inside); outer params/momentum/step replicate over dp
+    # inside); outer params/momentum/step replicate over dp.  With accum the
+    # leading microbatch axis is replicated and dim 1 carries the dp shard.
     stacked_spec = P("dp")
+    batch_spec = P("dp") if accum_steps == 1 else P(None, "dp")
     body = _shard_map(
         _body, mesh=mesh,
-        in_specs=(P(), stacked_spec, stacked_spec, P(), P(), P("dp")),
+        in_specs=(P(), stacked_spec, stacked_spec, P(), P(), batch_spec),
         out_specs=(stacked_spec, stacked_spec, P(), P(), P()),
         axis_names={"dp"}, check_vma=False)
 

@@ -306,7 +306,10 @@ def pipeline_1f1b(block_fn: Callable[[Any, jax.Array], jax.Array],
     forward ppermute and one backward ppermute per tick suffice.
 
     Args:
-        block_fn: (layer_params, h) -> h.
+        block_fn: (layer_params, h) -> h OR (h, aux_scalar) — MoE blocks
+            surface the router balance loss as aux; its value folds into
+            the reported loss and its 1/M cotangent is seeded in each
+            backward slot, so MoE composes with 1f1b.
         head_loss_fn: (head_params, h, aux_mb) -> scalar mean loss for one
             microbatch (runs on the last stage only).
         stacked_params: (L, ...) leaves, sharded P("pp").
@@ -321,13 +324,10 @@ def pipeline_1f1b(block_fn: Callable[[Any, jax.Array], jax.Array],
     pp = mesh.shape.get("pp", 1)
     if pp == 1:
         def _total(sp, hp, xm_):
-            def _layer(h, pl):
-                return block_fn(pl, h), None
-
             def _mb(carry, mx):
                 x_mb, aux_mb = mx
-                h = jax.lax.scan(_layer, x_mb, sp)[0]
-                return carry + head_loss_fn(hp, h, aux_mb), None
+                h, a = _scan_blocks(block_fn, x_mb, sp)
+                return carry + head_loss_fn(hp, h, aux_mb) + a, None
             total, _ = jax.lax.scan(_mb, jnp.zeros((), jnp.float32),
                                     (xm_, aux))
             return total / M
@@ -345,9 +345,10 @@ def pipeline_1f1b(block_fn: Callable[[Any, jax.Array], jax.Array],
         zero_h = jnp.zeros_like(xm_full[0])
 
         def _apply_stage(p, h):
-            def _layer(h, pl):
-                return block_fn(pl, h), None
-            return jax.lax.scan(_layer, h, p)[0]
+            # (y, aux_scalar): MoE blocks surface the router balance loss;
+            # dense blocks get aux = 0 and a zero cotangent — one uniform
+            # code path instead of a rejected composition
+            return _scan_blocks(block_fn, h, p)
 
         def _tick(carry, t):
             # Every slot computes unconditionally and masks its results:
@@ -363,9 +364,13 @@ def pipeline_1f1b(block_fn: Callable[[Any, jax.Array], jax.Array],
             fwd_valid = (m_f >= 0) & (m_f < M)
             m_fc = jnp.clip(m_f, 0, M - 1)
             h_in = jnp.where(stage == 0, xm_full[m_fc], fwd_buf)
-            y = _apply_stage(sp_local, h_in)
+            y, aux_t = _apply_stage(sp_local, h_in)
             stash = jnp.where(fwd_valid, stash.at[m_fc % S].set(h_in),
                               stash)
+            # the aux VALUE accumulates on the computing stage per valid
+            # forward; its psum over pp lands in the reported loss below
+            loss = loss + jnp.where(fwd_valid,
+                                    aux_t.astype(jnp.float32) / M, 0.0)
 
             # head + loss, kept on the last stage by masking (cotangent 1/M
             # folds the mean-over-microbatches into every downstream grad)
@@ -388,7 +393,13 @@ def pipeline_1f1b(block_fn: Callable[[Any, jax.Array], jax.Array],
             dy = jnp.where(stage == pp - 1, dh_seed, bwd_buf)
             h_s = stash[m_bc % S]
             _, stage_vjp = jax.vjp(_apply_stage, sp_local, h_s)
-            d_p_m, dh_prev = stage_vjp(dy.astype(h_s.dtype))
+            # seed BOTH outputs: dL/dy from downstream, dL/daux = 1/M (the
+            # mean-over-microbatches weight of the router balance loss) —
+            # this is the cotangent whose absence forced the old
+            # MoE x 1f1b rejection
+            d_p_m, dh_prev = stage_vjp(
+                (dy.astype(h_s.dtype),
+                 jnp.ones((), jnp.float32) / M))
             d_sp = jax.tree.map(
                 lambda acc, g: acc + jnp.where(bwd_valid, g,
                                                jnp.zeros_like(g)),
@@ -410,9 +421,9 @@ def pipeline_1f1b(block_fn: Callable[[Any, jax.Array], jax.Array],
         (_, _, _, d_sp, d_hp, d_xm, loss), _ = jax.lax.scan(
             _tick, carry0, jnp.arange(n_ticks))
 
-        # replicate single-stage accumulators over pp
-        loss = jax.lax.psum(
-            jnp.where(stage == pp - 1, loss, jnp.zeros_like(loss)), "pp")
+        # loss: CE lives on the last stage only (masked at accumulation);
+        # per-stage aux sums live everywhere — psum folds both
+        loss = jax.lax.psum(loss, "pp")
         d_hp = jax.tree.map(
             lambda g: jax.lax.psum(
                 jnp.where(stage == pp - 1, g, jnp.zeros_like(g)), "pp"),
@@ -497,12 +508,6 @@ class PipelinedLM:
         self.config = self.inner.config
         self._n_layer = getattr(self.config, "n_layer",
                                 getattr(self.config, "num_layers", 0))
-        if self.schedule == "1f1b" and \
-                getattr(self.config, "moe_experts", 0):
-            raise ValueError(
-                "pipeline schedule '1f1b' does not support MoE models — "
-                "its manual backward does not seed the router aux-loss "
-                "cotangent; use schedule='gpipe' or 'interleaved'")
         if getattr(self.config, "moe_experts", 0) and \
                 self.block_builder is not None and \
                 self.block_returns_aux is None:

@@ -34,6 +34,25 @@ class TrainState(NamedTuple):
                    opt_state=optimizer.init(params))
 
 
+def accumulate_grads(grad_fn, params, batch, accum_steps: int):
+    """Mean loss + mean grads over the leading microbatch axis of `batch`.
+
+    `grad_fn(micro) -> (loss, grads)`; f32 accumulators shaped like
+    `params`.  Shared by the plain train step and the DiLoCo inner step so
+    the accumulation semantics cannot diverge."""
+    def body(carry, micro):
+        loss_sum, grads_sum = carry
+        loss, grads = grad_fn(micro)
+        return (loss_sum + loss,
+                jax.tree.map(jnp.add, grads_sum, grads)), ()
+
+    zero = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+    (loss_sum, grads), _ = jax.lax.scan(
+        body, (jnp.zeros((), jnp.float32), zero), batch)
+    return (loss_sum / accum_steps,
+            jax.tree.map(lambda g: g / accum_steps, grads))
+
+
 def make_train_step(
     loss_fn: Callable[[Any, Any], jax.Array],
     optimizer: optax.GradientTransformation,
@@ -61,18 +80,9 @@ def make_train_step(
         if accum_steps == 1:
             loss, grads = _grads(state.params, batch)
         else:
-            def body(carry, micro):
-                loss_sum, grads_sum = carry
-                loss, grads = _grads(state.params, micro)
-                grads_sum = jax.tree.map(jnp.add, grads_sum, grads)
-                return (loss_sum + loss, grads_sum), ()
-
-            zero_grads = jax.tree.map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
-            (loss_sum, grads), _ = jax.lax.scan(
-                body, (jnp.zeros((), jnp.float32), zero_grads), batch)
-            loss = loss_sum / accum_steps
-            grads = jax.tree.map(lambda g: g / accum_steps, grads)
+            loss, grads = accumulate_grads(
+                lambda micro: _grads(state.params, micro), state.params,
+                batch, accum_steps)
         updates, opt_state = optimizer.update(grads, state.opt_state,
                                               state.params)
         params = optax.apply_updates(state.params, updates)
