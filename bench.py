@@ -185,6 +185,17 @@ def main():
                                      storage_type=StorageType.DISK)
         side["flash_ckpt_block_s"] = blocked
         ck.wait_latest_checkpoint(600)
+        # restore path (north star: restore < 30 s): full load of the
+        # committed checkpoint back onto the live state's shardings
+        t0 = time.perf_counter()
+        restored = ck.load_checkpoint(state._asdict())
+        assert restored is not None
+        # host readback: the batched device_put is async and
+        # block_until_ready is a no-op over the tunnel
+        float(jnp.float32(
+            jax.tree.leaves(restored)[1].reshape(-1)[0]))
+        side["restore_s"] = round(time.perf_counter() - t0, 3)
+        del restored
         ck.close()
     except Exception as e:  # noqa: BLE001
         side["flash_ckpt_error"] = repr(e)

@@ -371,6 +371,7 @@ def restore_pytree(template: Any, flat: Dict[str, np.ndarray]) -> Any:
 
     flat_template = flatten_state_dict(template)
     leaves_by_name = {}
+    put_names, put_values, put_shardings = [], [], []
     for name, leaf in flat_template.items():
         if name not in flat:
             raise KeyError(f"checkpoint missing tensor {name!r}")
@@ -380,9 +381,17 @@ def restore_pytree(template: Any, flat: Dict[str, np.ndarray]) -> Any:
         if dtype is not None and value.dtype != dtype:
             value = value.astype(dtype)
         if sharding is not None:
-            leaves_by_name[name] = jax.device_put(value, sharding)
+            put_names.append(name)
+            put_values.append(value)
+            put_shardings.append(sharding)
         else:
             leaves_by_name[name] = value
+    # ONE batched device_put for all leaves: per-leaf puts serialize a
+    # host round-trip each (measured 48 s for a GPT-2 state over the
+    # axon tunnel); the batched form overlaps the transfers
+    for name, placed in zip(put_names,
+                            jax.device_put(put_values, put_shardings)):
+        leaves_by_name[name] = placed
     # rebuild in template order
     treedef = jax.tree_util.tree_structure(template)
     ordered = [leaves_by_name[name] for name in flat_template]

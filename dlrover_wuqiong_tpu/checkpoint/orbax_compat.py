@@ -1,0 +1,97 @@
+"""Orbax layout interop for flash checkpoints.
+
+Parity: SURVEY.md §7 item 3 — the reference ecosystem reads Megatron/HF
+checkpoint layouts; the JAX ecosystem's lingua franca is Orbax.  Flash
+checkpoints use a framework-internal layout (shm-staged raw shard files +
+done-dir commit) optimized for sub-second saves; this module converts both
+ways so checkpoints are not framework-locked:
+
+    export_orbax(flash_dir, orbax_path, template)   # flash -> Orbax tree
+    state = load_orbax(orbax_path, template)        # Orbax -> sharded state
+    import_orbax(orbax_path, flash_dir, template)   # Orbax -> flash layout
+
+`template` is a pytree of (sharded) arrays — restores land on the
+template's shardings, so a checkpoint written on one mesh reloads onto
+another (same restore-with-resharding semantics as the flash loader).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+
+from ..common.log import get_logger
+
+logger = get_logger("orbax_compat")
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.StandardCheckpointer()
+
+
+def _abstract_like(template: Any):
+    """Template -> abstract tree carrying shape/dtype/sharding only."""
+    def leaf(x):
+        sharding = getattr(x, "sharding", None)
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
+
+    return jax.tree.map(leaf, template)
+
+
+def save_orbax(path: str, state: Any) -> None:
+    """Write a pytree in Orbax StandardCheckpointer layout."""
+    ckptr = _checkpointer()
+    ckptr.save(os.path.abspath(path), state, force=True)
+    ckptr.wait_until_finished()
+    ckptr.close()
+
+
+def load_orbax(path: str, template: Any) -> Any:
+    """Read an Orbax checkpoint onto the template's shardings."""
+    ckptr = _checkpointer()
+    try:
+        return ckptr.restore(os.path.abspath(path),
+                             _abstract_like(template))
+    finally:
+        ckptr.close()
+
+
+def export_orbax(flash_dir: str, orbax_path: str, template: Any,
+                 step: Optional[int] = None,
+                 job_name: str = "orbax-export") -> Any:
+    """Flash checkpoint dir -> Orbax layout; returns the exported state."""
+    from .checkpointer import FlashCheckpointer
+
+    ck = FlashCheckpointer(flash_dir, job_name=job_name)
+    try:
+        state = ck.load_checkpoint(template, step=step)
+    finally:
+        ck.close()
+    if state is None:
+        raise FileNotFoundError(
+            f"no committed flash checkpoint under {flash_dir}")
+    save_orbax(orbax_path, state)
+    logger.info("exported flash checkpoint %s (step=%s) to orbax %s",
+                flash_dir, step, orbax_path)
+    return state
+
+
+def import_orbax(orbax_path: str, flash_dir: str, template: Any,
+                 step: int = 0, job_name: str = "orbax-import") -> Any:
+    """Orbax checkpoint -> committed flash layout; returns the state."""
+    from .checkpointer import FlashCheckpointer, StorageType
+
+    state = load_orbax(orbax_path, template)
+    ck = FlashCheckpointer(flash_dir, job_name=job_name)
+    try:
+        ck.save_checkpoint(step, state, storage_type=StorageType.DISK)
+        ck.wait_latest_checkpoint(600)
+    finally:
+        ck.close()
+    logger.info("imported orbax %s into flash layout %s (step=%d)",
+                orbax_path, flash_dir, step)
+    return state
