@@ -182,6 +182,22 @@ def _s_module_replace(ctx: StrategyContext, cfg: Dict, num_devices: int):
     ctx.flash_attention = cfg.get("enabled", True)
 
 
+@register_strategy("stable_bf16")
+@register_strategy("bf16_optimizer")
+def _s_stable_bf16(ctx: StrategyContext, cfg: Dict, num_devices: int):
+    """bf16 params trained stably — Kahan compensation (default) or f32
+    master weights ({"master": True}).  Parity: reference
+    bf16_optimizer.py:46; impl optimizers/bf16_stable.py."""
+    ctx.extra["stable_bf16"] = {"master": bool(cfg.get("master", False))}
+
+
+@register_strategy("optimizer_offload")
+def _s_opt_offload(ctx: StrategyContext, cfg: Dict, num_devices: int):
+    """Optimizer moments in host memory (pinned_host) — parity: reference
+    adam_offload.py:87 PartitionAdam host-offloaded states."""
+    ctx.extra["optimizer_offload"] = cfg.get("enabled", True)
+
+
 @register_strategy("grad_accum")
 def _s_accum(ctx: StrategyContext, cfg: Dict, num_devices: int):
     ctx.accum_steps = cfg.get("steps", 1)
@@ -358,6 +374,12 @@ def auto_accelerate(
 
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     optimizer = optimizer or optax.adamw(3e-4)
+    stable_bf16_cfg = ctx.extra.get("stable_bf16")
+    if stable_bf16_cfg is not None:
+        from ..optimizers.bf16_stable import stable_bf16
+
+        optimizer = stable_bf16(optimizer,
+                                master=stable_bf16_cfg["master"])
     loss = loss_fn or make_lm_loss(model.apply)
 
     if ctx.extra.get("local_sgd") is not None:
@@ -375,6 +397,14 @@ def auto_accelerate(
         )
 
         ls_cfg = LocalSGDConfig(**ctx.extra["local_sgd"])
+        if ctx.extra.get("optimizer_offload") or \
+                ctx.extra.get("stable_bf16") is not None:
+            # the DiLoCo state builder manages its own two-level trees;
+            # silently skipping these strategies would deliver neither
+            # the HBM savings nor the precision contract
+            raise ValueError(
+                "local_sgd does not compose with optimizer_offload / "
+                "stable_bf16 yet — drop one of the strategies")
         if ctx.plan.dp < 2:
             raise ValueError(
                 "local_sgd needs ('data_parallel', {'size': R>=2}) — the "
@@ -398,18 +428,42 @@ def auto_accelerate(
         # process ever holds the unsharded 8B tree the old eager
         # `model.init_params(rng)` + device_put path required.
         def _create_state(r):
-            return TrainState.create(model.init_params(r), optimizer)
+            params = model.init_params(r)
+            if stable_bf16_cfg is not None:
+                # bf16 PARAMS (not just compute dtype): halves param HBM
+                # and FSDP all-gather bytes; stable_bf16 keeps updates
+                # from vanishing below the bf16 ulp
+                params = jax.tree.map(
+                    lambda p: p.astype(jnp.bfloat16)
+                    if jnp.issubdtype(p.dtype, jnp.floating) else p,
+                    params)
+            return TrainState.create(params, optimizer)
 
         abstract = jax.eval_shape(_create_state, rng)
-        state_sh = train_state_shardings(abstract, planner)
-        state = jax.jit(_create_state, out_shardings=state_sh)(rng)
+        offload_opt = bool(ctx.extra.get("optimizer_offload"))
+        state_sh = train_state_shardings(abstract, planner,
+                                         offload_opt=offload_opt)
+        if offload_opt:
+            # jit-init cannot emit host-memory outputs under SPMD (the
+            # device-placement annotation defeats the partitioner), so
+            # init lands on device shardings and the moments hop to
+            # pinned_host right after — a one-time transfer at init
+            dev_sh = train_state_shardings(abstract, planner)
+            state = jax.jit(_create_state, out_shardings=dev_sh)(rng)
+            state = jax.device_put(state, state_sh)
+        else:
+            state = jax.jit(_create_state, out_shardings=state_sh)(rng)
         vg_fn = None
         if ctx.plan.pp > 1 and ctx.extra.get("pp_schedule") == "1f1b":
             # manual fwd/bwd interleave replaces autodiff-through-apply
             vg_fn = model.value_and_grad
-        step = make_train_step(loss, optimizer, mesh, planner,
-                               accum_steps=ctx.accum_steps,
-                               value_and_grad_fn=vg_fn)
+        step = make_train_step(
+            loss, optimizer, mesh, planner, accum_steps=ctx.accum_steps,
+            value_and_grad_fn=vg_fn,
+            opt_host_shardings=(state_sh.opt_state if offload_opt
+                                else None),
+            opt_device_shardings=(dev_sh.opt_state if offload_opt
+                                  else None))
     logger.info("auto_accelerate: mesh=%s params=%s accum=%d",
                 ctx.plan.describe(),
                 f"{num_params:,}" if num_params else "?", ctx.accum_steps)

@@ -1,0 +1,101 @@
+"""Stable bf16 parameter training: Kahan compensation or f32 masters.
+
+Parity: reference `atorch/atorch/optimizers/bf16_optimizer.py:46`
+(BF16Optimizer — bf16 model weights trained stably against f32 master
+weights, so small updates are not lost to bf16's 8-bit mantissa).
+
+TPU redesign: an optax wrapper instead of an optimizer subclass, so it
+composes with every inner optimizer in the zoo (adamw, AGD, WSAM, 8-bit
+states, muP).  Two modes:
+
+- Kahan (default): params stay bf16; the state carries a bf16
+  compensation term `e` per parameter.  Each step applies
+  v = f32(p) + f32(e) + f32(u); p' = bf16(v); e' = bf16(v - f32(p')).
+  p'+e' together behave like a ~16-bit-mantissa accumulator at HALF the
+  f32-master memory (2+2 vs 2+4 bytes/param).  Without it, any update
+  smaller than half a bf16 ulp of the weight (|u| < ~0.002|p|) is lost
+  entirely — late-training lr regimes sit exactly there.
+- master=True: classic f32 master weights in the optimizer state (exact
+  reference parity); weight decay and the inner update see the master.
+
+Exactness contract with `optax.apply_updates`: the wrapper emits f32
+updates `f32(p') - f32(p)`.  Both operands are bf16-representable, so the
+difference is exact in f32, `p + u` reconstructs exactly f32(p'), and
+apply_updates' cast back to bf16 lands on p' bit-for-bit.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class StableBF16State(NamedTuple):
+    inner: Any
+    comp: Any  # kahan: bf16 error feedback; master: f32 master weights
+
+
+def _is_float(p) -> bool:
+    return jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating)
+
+
+def stable_bf16(inner: optax.GradientTransformation,
+                master: bool = False) -> optax.GradientTransformation:
+    """Wrap `inner` so bf16 params train without losing small updates."""
+
+    def init_fn(params):
+        if master:
+            comp = jax.tree.map(
+                lambda p: p.astype(jnp.float32) if _is_float(p) else p,
+                params)
+        else:
+            comp = jax.tree.map(
+                lambda p: (jnp.zeros(p.shape, jnp.bfloat16)
+                           if _is_float(p) else jnp.zeros_like(p)),
+                params)
+        # the inner state (adam mu/nu, ...) inits from an f32 view —
+        # zeros_like(bf16 params) would silently carry 8-mantissa-bit
+        # moments, the very accumulation loss this wrapper prevents
+        f32_params = jax.tree.map(
+            lambda p: p.astype(jnp.float32) if _is_float(p) else p, params)
+        return StableBF16State(inner=inner.init(f32_params), comp=comp)
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("stable_bf16 requires params")
+        # the inner rule (incl. adamw weight decay) sees the PRECISE
+        # value: the f32 master, or the Kahan-compensated view
+        if master:
+            precise = state.comp
+        else:
+            precise = jax.tree.map(
+                lambda p, e: (p.astype(jnp.float32) + e.astype(jnp.float32)
+                              if _is_float(p) else p),
+                params, state.comp)
+        u, inner_s = inner.update(updates, state.inner, precise)
+
+        def _apply(p, e_or_m, ui):
+            if not _is_float(p):
+                return jnp.zeros_like(p), e_or_m
+            if master:
+                new_m = e_or_m + ui.astype(jnp.float32)
+                new_p = new_m.astype(p.dtype)
+                return new_p.astype(jnp.float32) - p.astype(jnp.float32), \
+                    new_m
+            v = (p.astype(jnp.float32) + e_or_m.astype(jnp.float32)
+                 + ui.astype(jnp.float32))
+            new_p = v.astype(p.dtype)
+            new_e = (v - new_p.astype(jnp.float32)).astype(e_or_m.dtype)
+            return new_p.astype(jnp.float32) - p.astype(jnp.float32), new_e
+
+        pairs = jax.tree.map(_apply, params, state.comp, u)
+        out = jax.tree.map(lambda pr: pr[0], pairs,
+                           is_leaf=lambda x: isinstance(x, tuple))
+        comp = jax.tree.map(lambda pr: pr[1], pairs,
+                            is_leaf=lambda x: isinstance(x, tuple))
+        return out, StableBF16State(inner=inner_s, comp=comp)
+
+    return optax.GradientTransformation(init_fn, update_fn)

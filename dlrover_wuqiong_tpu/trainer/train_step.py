@@ -61,6 +61,8 @@ def make_train_step(
     accum_steps: int = 1,
     donate: bool = True,
     value_and_grad_fn: Optional[Callable] = None,
+    opt_host_shardings: Any = None,
+    opt_device_shardings: Any = None,
 ):
     """Returns jit'd `step(state, batch) -> (state, metrics)`.
 
@@ -68,6 +70,9 @@ def make_train_step(
     accumulation is on: shape (accum, per_device_batch * data_axes, ...).
     `value_and_grad_fn(params, batch) -> (loss, grads)` overrides the default
     autodiff path (used by the manual 1F1B pipeline schedule).
+    `opt_host_shardings`/`opt_device_shardings` (both or neither): the
+    optimizer state lives in host memory between steps (optimizer_offload
+    strategy) — the step hops it to device for the update and back.
     """
 
     def _grads(params, batch):
@@ -83,14 +88,21 @@ def make_train_step(
             loss, grads = accumulate_grads(
                 lambda micro: _grads(state.params, micro), state.params,
                 batch, accum_steps)
-        updates, opt_state = optimizer.update(grads, state.opt_state,
-                                              state.params)
+        opt_in = state.opt_state
+        if opt_host_shardings is not None:
+            opt_in = jax.device_put(opt_in, opt_device_shardings)
+        updates, opt_state = optimizer.update(grads, opt_in, state.params)
+        if opt_host_shardings is not None:
+            opt_state = jax.device_put(opt_state, opt_host_shardings)
         params = optax.apply_updates(state.params, updates)
         gnorm = optax.global_norm(grads)
         new_state = TrainState(state.step + 1, params, opt_state)
         return new_state, {"loss": loss, "grad_norm": gnorm}
 
-    donate_argnums = (0,) if donate else ()
+    # offloaded opt states: donation would let XLA alias a pinned_host
+    # input buffer onto a device-memory output (same shape/dtype) and the
+    # runtime rejects the memory-kind mismatch — keep donation off there
+    donate_argnums = (0,) if donate and opt_host_shardings is None else ()
     return jax.jit(train_step, donate_argnums=donate_argnums)
 
 
@@ -106,15 +118,29 @@ def shard_train_state(state: TrainState, planner: ShardingPlanner
     return placed, state_sh
 
 
-def train_state_shardings(state_like: TrainState, planner: ShardingPlanner
-                          ) -> TrainState:
+def train_state_shardings(state_like: TrainState, planner: ShardingPlanner,
+                          offload_opt: bool = False) -> TrainState:
     """Shardings for a TrainState, from a concrete OR abstract
     (jax.eval_shape) instance — never touches leaf values, so the full
     tree need not exist (sharded-by-construction init, parity
-    atorch/utils/meta_model_utils.py:759 deferred materialization)."""
+    atorch/utils/meta_model_utils.py:759 deferred materialization).
+
+    offload_opt=True places the param-shaped optimizer moments in HOST
+    memory (pinned_host memory kind): at 8B-class scale Adam states
+    dominate the HBM budget (parity: reference adam_offload.py:87
+    PartitionAdam).  XLA streams them device<->host around the update."""
     state = state_like
     param_sh = planner.param_shardings(state.params)
     repl = planner.replicated()
+    opt_moment_sh = param_sh
+    if offload_opt:
+        from jax.sharding import NamedSharding
+
+        opt_moment_sh = jax.tree.map(
+            lambda sh: NamedSharding(sh.mesh, sh.spec,
+                                     memory_kind="pinned_host"),
+            param_sh,
+            is_leaf=lambda x: isinstance(x, NamedSharding))
 
     # optimizer moments (adam mu/nu, etc.) mirror the param pytree: any
     # opt_state subtree whose structure equals the param tree gets the param
@@ -137,7 +163,7 @@ def train_state_shardings(state_like: TrainState, planner: ShardingPlanner
             return False
 
     opt_sh = jax.tree.map(
-        lambda sub: (param_sh if _is_param_shaped(sub)
+        lambda sub: (opt_moment_sh if _is_param_shaped(sub)
                      else jax.tree.map(lambda _: repl, sub)),
         state.opt_state, is_leaf=_is_param_shaped)
     return TrainState(step=repl, params=param_sh, opt_state=opt_sh)
