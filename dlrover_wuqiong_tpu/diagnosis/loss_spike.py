@@ -1,0 +1,81 @@
+"""Loss-spike detection feeding the diagnosis chain.
+
+Parity: reference `atorch/atorch/utils/loss_spike_utils.py:1-156`
+(TokenLossSpike: sliding loss window, spike = ratio-over-average, sample
+capture for postmortem).
+
+TPU/control-plane redesign: workers push per-step losses through the
+existing typed diagnosis report stream ("loss" payloads); the master-side
+operator below runs inside the InferenceChain next to hang/straggler/OOM
+detection, so a spike becomes a first-class DiagnosisAction ("rollback" —
+restart the worker, which auto-resumes from the last committed flash
+checkpoint, i.e. a state from before the spike) instead of a
+worker-local log line.
+
+Detection is ROBUST-statistics based: a spike must exceed the trailing
+window's median by `sigma` robust standard deviations (MAD * 1.4826) AND
+by a multiplicative `ratio` — the double test keeps ordinary optimization
+noise (tiny MAD early in training, heavy-tailed batches later) from
+firing.  A non-finite loss is always a spike.
+"""
+
+from __future__ import annotations
+
+import math
+import statistics
+from typing import List
+
+from ..common.log import get_logger
+from .manager import DiagnosisDataManager, Inference, InferenceOperator
+
+logger = get_logger("loss_spike")
+
+
+class CheckLossSpikeOperator(InferenceOperator):
+    """Symptom operator: windowed robust spike test per node."""
+
+    name = "loss_spike"
+
+    def __init__(self, sigma: float = 4.0, ratio: float = 1.5,
+                 min_points: int = 10, max_age: float = 300.0):
+        self.sigma = sigma
+        self.ratio = ratio
+        self.min_points = min_points
+        self.max_age = max_age
+
+    def infer(self, data: DiagnosisDataManager,
+              problems: List[Inference]) -> List[Inference]:
+        import time as _time
+
+        out = []
+        now = _time.time()
+        for node_id, series in data.loss_series().items():
+            if not series:
+                continue
+            ts, last_step, last = series[-1]
+            if now - ts > self.max_age:
+                # stale tail (worker restarting / eval phase): without this
+                # gate the SAME spike sample re-fires a rollback every
+                # cooldown interval until a fresh report displaces it
+                continue
+            if not math.isfinite(last):
+                out.append(Inference(
+                    "loss_spike", node_id=node_id, is_conclusion=True,
+                    detail=f"non-finite loss {last} at step {last_step}"))
+                continue
+            hist = [x for _, _, x in series[:-1] if math.isfinite(x)]
+            if len(hist) < self.min_points:
+                continue
+            med = statistics.median(hist)
+            mad = statistics.median(abs(x - med) for x in hist) * 1.4826
+            # floor the scale: a perfectly flat window must still allow
+            # ordinary float jitter without declaring a spike
+            scale = max(mad, 1e-3, abs(med) * 0.01)
+            if (last > med + self.sigma * scale
+                    and last > self.ratio * max(med, 1e-8)):
+                out.append(Inference(
+                    "loss_spike", node_id=node_id, is_conclusion=True,
+                    detail=(f"loss {last:.4g} at step {last_step} vs "
+                            f"median {med:.4g} (mad {mad:.4g}) over "
+                            f"{len(hist)} points")))
+        return out

@@ -61,6 +61,7 @@ class DiagnosisDataManager:
         self._stacks: Dict[int, str] = {}
         self._op_profiles: Dict[int, Tuple[float, str]] = {}
         self._probes: Dict[int, Tuple[float, bool]] = {}
+        self._losses: Dict[int, Deque] = {}
 
     def forget_node(self, node_id: int):
         """Drop a departed node's series — stale timestamps otherwise keep
@@ -71,6 +72,7 @@ class DiagnosisDataManager:
             self._stacks.pop(node_id, None)
             self._op_profiles.pop(node_id, None)
             self._probes.pop(node_id, None)
+            self._losses.pop(node_id, None)
 
     def store_report(self, report: msg.DiagnosisReport):
         with self._lock:
@@ -94,6 +96,16 @@ class DiagnosisDataManager:
                 # xpu_timer parity: worker-pushed top-slow-collective JSON
                 # (utils/xplane.py OpProfile.collective_evidence)
                 self._op_profiles[report.node_id] = (ts, report.content)
+            elif report.payload_type == "loss":
+                # {"step": N, "loss": x} — feeds CheckLossSpikeOperator
+                try:
+                    d = json.loads(report.content)
+                    self._losses.setdefault(
+                        report.node_id, deque(maxlen=256)).append(
+                        (ts, int(d.get("step", -1)),
+                         float(d.get("loss", float("nan")))))
+                except (ValueError, TypeError):
+                    pass
             elif report.payload_type == "probe":
                 # device-queue liveness (diagnosis/probe.py DeviceProber)
                 try:
@@ -125,6 +137,10 @@ class DiagnosisDataManager:
     def node_stack(self, node_id: int) -> str:
         with self._lock:
             return self._stacks.get(node_id, "")
+
+    def loss_series(self) -> Dict[int, List[Tuple[float, int, float]]]:
+        with self._lock:
+            return {n: list(d) for n, d in self._losses.items()}
 
     def probe_status(self, max_age: float = 300.0) -> Dict[int, bool]:
         """node → device-queue-idle? from recent DeviceProber reports."""
@@ -359,6 +375,9 @@ _ACTION_FOR = {
     "straggler": "report",           # surfaced; operator policy decides
     "memory_over_limit": "relaunch_node",
     "memory_trend": "report",
+    # rollback = restart the worker; it auto-resumes from the last
+    # committed flash checkpoint — a pre-spike state (diagnosis/loss_spike)
+    "loss_spike": "rollback",
 }
 
 
@@ -368,11 +387,14 @@ class DiagnosisManager:
     def __init__(self, hang_timeout: float = 1800.0,
                  memory_limit_mb: float = 0.0, job_manager=None,
                  action_cooldown: float = 0.0):
+        from .loss_spike import CheckLossSpikeOperator
+
         self.data = DiagnosisDataManager()
         self.chain = InferenceChain([
             CheckTrainingHangOperator(hang_timeout),
             CheckStragglerOperator(),
             CheckMemoryTrendOperator(memory_limit_mb),
+            CheckLossSpikeOperator(),
             ResolveHangCauseOperator(),
         ])
         self.job_manager = job_manager
@@ -428,7 +450,7 @@ class DiagnosisManager:
         if self.job_manager is None or action.action == "report":
             return
         try:
-            if action.action == "restart_worker":
+            if action.action in ("restart_worker", "rollback"):
                 nodes = ([self.job_manager.get_node(action.node_id)]
                          if action.node_id >= 0
                          else self.job_manager.running_nodes())

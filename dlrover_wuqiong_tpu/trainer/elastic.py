@@ -76,6 +76,21 @@ class ElasticContext:
             except Exception:  # noqa: BLE001
                 logger.debug("step report failed", exc_info=True)
 
+    def report_loss(self, step: int, loss: float):
+        """Feed the master's loss-spike detector (diagnosis/loss_spike.py).
+
+        Reported at the trainer's logging cadence — the detector works on
+        a trailing window of samples, not every step."""
+        if self.mc is None:
+            return
+        try:
+            import json as _json
+
+            self.mc.report_diagnosis(
+                "loss", _json.dumps({"step": step, "loss": float(loss)}))
+        except Exception:  # noqa: BLE001
+            logger.debug("loss report failed", exc_info=True)
+
     def report_op_profile(self, evidence: str):
         """Push top-slow-collective evidence (utils/xplane.py) to the
         master's diagnosis chain — xpu_timer parity for hang localization."""

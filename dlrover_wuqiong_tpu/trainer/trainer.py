@@ -248,6 +248,7 @@ class Trainer:
                     logger.info("step %d loss=%.4f tokens/s=%.0f",
                                 step + 1, last_loss, tps)
                     self.ctx.report_step(step + 1)
+                    self.ctx.report_loss(step + 1, last_loss)
                     for cb in self.callbacks:
                         cb(step + 1, {"loss": last_loss,
                                       "tokens_per_sec": tps})

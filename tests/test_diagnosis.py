@@ -128,3 +128,57 @@ class TestActionCoupling:
             node_id=0, payload_type="step", content="s",
             timestamp=time.time()))
         assert act.action == "restart_worker"
+
+
+def _loss(data, node, step, loss, ts=None):
+    data.store_report(msg.DiagnosisReport(
+        node_id=node, payload_type="loss",
+        content=json.dumps({"step": step, "loss": loss}),
+        timestamp=ts or time.time()))
+
+
+class TestLossSpike:
+    """Loss-spike detection (parity atorch utils/loss_spike_utils.py):
+    windowed robust statistics on reported losses; spike -> diagnosis
+    conclusion -> rollback action (restart; auto-resume from the last
+    committed checkpoint = pre-spike state)."""
+
+    def _feed(self, dm, losses, node=0):
+        for i, l in enumerate(losses):
+            _loss(dm.data, node, i, l)
+
+    def test_spike_triggers_rollback_and_restart(self):
+        jm = JobManager()
+        node = jm.register_node(NodeType.WORKER, 0)
+        node.update_status(NodeStatus.RUNNING)
+        dm = DiagnosisManager(hang_timeout=1e9, job_manager=jm)
+        _step(dm.data, 0, time.time())  # alive — no hang noise
+        self._feed(dm, [2.0 + 0.01 * (i % 5) for i in range(20)] + [9.5])
+        actions = dm.diagnose_once()
+        assert any(a.action == "rollback" and "loss_spike" in a.reason
+                   for a in actions), actions
+        assert node.restart_training  # rollback = restart + flash resume
+        assert jm.collect_heartbeat(0) == "restart"
+
+    def test_normal_noise_does_not_fire(self):
+        dm = DiagnosisManager(hang_timeout=1e9)
+        _step(dm.data, 0, time.time())
+        # decreasing loss with ordinary noise, incl. a mild 20% bump
+        losses = [3.0 - 0.05 * i for i in range(20)] + [2.4]
+        self._feed(dm, losses)
+        actions = dm.diagnose_once()
+        assert not any(a.action == "rollback" for a in actions), actions
+
+    def test_non_finite_loss_always_fires(self):
+        dm = DiagnosisManager(hang_timeout=1e9)
+        _step(dm.data, 0, time.time())
+        self._feed(dm, [2.0, 1.9, float("nan")])
+        actions = dm.diagnose_once()
+        assert any(a.action == "rollback" for a in actions), actions
+
+    def test_warmup_window_silent(self):
+        dm = DiagnosisManager(hang_timeout=1e9)
+        _step(dm.data, 0, time.time())
+        self._feed(dm, [5.0, 100.0])  # too few points to judge
+        actions = dm.diagnose_once()
+        assert not any(a.action == "rollback" for a in actions), actions
