@@ -168,13 +168,25 @@ class PPOTrainer:
     """
 
     def __init__(self, cfg: GPTConfig, ppo: PPOConfig,
-                 reward_fn: Callable, seed: int = 0):
+                 reward_fn: Callable, seed: int = 0,
+                 devices=None, decode_tp: int = 1, train_plan=None):
+        """`devices`: enable the hybrid engine (rl/hybrid.py) — training
+        sharded over a train mesh, rollouts on a tp-only decode mesh with
+        per-iteration weight sync (parity: reference
+        ds_hybrid_engine/hybrid_engine.py)."""
         self.model_cfg = cfg
         self.ppo = ppo
         self.reward_fn = reward_fn
         self.model = ActorCritic(cfg)
         key = jax.random.PRNGKey(seed)
         self.params = self.model.init_params(key)
+        self.engine = None
+        if devices is not None:
+            from .hybrid import HybridEngine
+
+            self.engine = HybridEngine(devices, train_plan=train_plan,
+                                       decode_tp=decode_tp)
+            self.params = self.engine.place_train(self.params)
         self.ref_params = jax.tree.map(jnp.copy, self.params["gpt"])
         self.opt = optax.adam(ppo.lr)
         self.opt_state = self.opt.init(self.params)
@@ -203,8 +215,18 @@ class PPOTrainer:
         self._rng, sub = jax.random.split(self._rng)
         sample = SampleConfig(max_new_tokens=self.ppo.max_new_tokens,
                               temperature=self.ppo.temperature)
-        tokens, logprobs = generate(self.model_cfg, self.params["gpt"],
+        actor = self.params["gpt"]
+        if self.engine is not None:
+            # rollouts run on the DECODE mesh: actor weights sync to the
+            # tp-only placement (timed), prompts shard over decode dp
+            actor = self.engine.sync_to_decode(actor)
+            prompts = self.engine.place_prompts(prompts)
+        tokens, logprobs = generate(self.model_cfg, actor,
                                     prompts, sub, sample)
+        if self.engine is not None:
+            # scoring + PPO updates run on the TRAIN mesh
+            tokens = self.engine.place_batch_train(tokens)
+            logprobs = self.engine.place_batch_train(logprobs)
         P = prompts.shape[1]
         ref_logp, _ = _response_logprobs_values(
             self.model, dict(self.params, gpt=self.ref_params), tokens, P)
@@ -239,6 +261,8 @@ class PPOTrainer:
         out["loss"] = float(loss)
         out["reward"] = float(roll.rewards.sum(axis=1).mean())
         out["kl"] = float((roll.logprobs - roll.ref_logprobs).mean())
+        if self.engine is not None:
+            out["weight_sync_s"] = self.engine.last_sync_s
         for k, v in aux.items():
             out[k] = float(v)
         return out
