@@ -199,9 +199,14 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
 
 def _fit_pack(bh: int) -> int:
-    """Heads packed per grid step: largest of 8/4/2/1 dividing bh."""
-    for p in (8, 4, 2):
-        if bh % p == 0:
+    """Heads packed per grid step: largest of 8/4/2/1 dividing bh.
+
+    DWT_FA_PACK overrides the preference order's head (sweep hook)."""
+    import os
+
+    pref = int(os.getenv("DWT_FA_PACK", "8"))
+    for p in (pref, 8, 4, 2):
+        if p >= 1 and bh % p == 0:
             return p
     return 1
 
