@@ -133,8 +133,13 @@ class BayesianOptimizer:
 
     def tell(self, cfg: Dict[str, float], y: float):
         u = np.array([p.to_unit(cfg[p.name]) for p in self.params])
+        y = float(y)
+        if not math.isfinite(y):
+            # worst-observed substitution — see hebo.py tell()
+            finite = [v for v in self._ys if math.isfinite(v)]
+            y = (max(finite) if finite else 0.0) + 1.0
         self._xs.append(u)
-        self._ys.append(float(y))
+        self._ys.append(y)
 
     def best(self) -> Tuple[Dict[str, float], float]:
         i = int(np.argmin(self._ys))

@@ -204,7 +204,10 @@ def _fit_pack(bh: int) -> int:
     DWT_FA_PACK overrides the preference order's head (sweep hook)."""
     import os
 
-    pref = int(os.getenv("DWT_FA_PACK", "8"))
+    try:
+        pref = int(os.getenv("DWT_FA_PACK", "8"))
+    except ValueError:  # empty/garbage env value: fall back, don't abort
+        pref = 8
     for p in (pref, 8, 4, 2):
         if p >= 1 and bh % p == 0:
             return p
