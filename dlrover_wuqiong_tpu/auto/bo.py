@@ -46,6 +46,58 @@ def _rbf(a: np.ndarray, b: np.ndarray, ls: float) -> np.ndarray:
     return np.exp(-0.5 * d2 / (ls * ls))
 
 
+def jittered_cholesky(k: np.ndarray) -> Optional[np.ndarray]:
+    """Cholesky with diagonal jitter escalation; None if never PD."""
+    jitter = 0.0
+    for _ in range(8):
+        try:
+            return np.linalg.cholesky(k + jitter * np.eye(len(k)))
+        except np.linalg.LinAlgError:
+            jitter = max(1e-10, jitter * 10 or 1e-10)
+    return None
+
+
+class AskTellBase:
+    """Shared ask/tell bookkeeping for the HP optimizers.
+
+    Observations are stored RAW (including nan/inf from diverged trials);
+    `fit_ys()` substitutes worst-observed+1 lazily at fit time — an early
+    nan must not freeze into a small sentinel that later real losses
+    cannot beat — and `best()` considers finite observations only.
+    """
+
+    def __init__(self, params: Sequence[Param], seed: int):
+        self.params = list(params)
+        self._rng = np.random.default_rng(seed)
+        self._xs: List[np.ndarray] = []   # unit cube
+        self._ys: List[float] = []        # raw, may contain nan/inf
+
+    def _to_cfg(self, u: np.ndarray) -> Dict[str, float]:
+        return {p.name: p.from_unit(float(u[i]))
+                for i, p in enumerate(self.params)}
+
+    def tell(self, cfg: Dict[str, float], y: float):
+        u = np.array([p.to_unit(cfg[p.name]) for p in self.params])
+        self._xs.append(u)
+        self._ys.append(float(y))
+
+    def fit_ys(self) -> np.ndarray:
+        ys = np.array(self._ys, float)
+        finite = np.isfinite(ys)
+        if not finite.all():
+            worst = float(ys[finite].max()) if finite.any() else 0.0
+            ys = np.where(finite, ys, worst + 1.0)
+        return ys
+
+    def best(self) -> Tuple[Dict[str, float], float]:
+        ys = np.array(self._ys, float)
+        finite = np.isfinite(ys)
+        if not finite.any():
+            raise ValueError("no finite observations yet")
+        i = int(np.where(finite, ys, np.inf).argmin())
+        return self._to_cfg(self._xs[i]), float(ys[i])
+
+
 class GaussianProcess:
     def __init__(self, length_scale: float = 0.2, noise: float = 1e-6):
         self.ls = length_scale
@@ -64,15 +116,7 @@ class GaussianProcess:
         yn = (y - self._y_mean) / self._y_std
         k = _rbf(self._x, self._x, self.ls)
         k[np.diag_indices_from(k)] += self.noise
-        # jittered cholesky: bump the diagonal until PD
-        jitter = 0.0
-        for _ in range(8):
-            try:
-                self._chol = np.linalg.cholesky(
-                    k + jitter * np.eye(len(k)))
-                break
-            except np.linalg.LinAlgError:
-                jitter = max(1e-10, jitter * 10 or 1e-10)
+        self._chol = jittered_cholesky(k)
         self._alpha = np.linalg.solve(
             self._chol.T, np.linalg.solve(self._chol, yn))
 
@@ -93,7 +137,7 @@ def _norm_pdf(z):
     return np.exp(-0.5 * z * z) / math.sqrt(2.0 * math.pi)
 
 
-class BayesianOptimizer:
+class BayesianOptimizer(AskTellBase):
     """Minimize a black-box objective over a box of Params.
 
     Usage (ask/tell, mirroring the reference's generator interface):
@@ -106,41 +150,21 @@ class BayesianOptimizer:
 
     def __init__(self, params: Sequence[Param], seed: int = 0,
                  n_init: int = 5, xi: float = 0.01):
-        self.params = list(params)
-        self._rng = np.random.default_rng(seed)
+        super().__init__(params, seed)
         self._n_init = n_init
         self._xi = xi
-        self._xs: List[np.ndarray] = []   # unit cube
-        self._ys: List[float] = []
         self._gp = GaussianProcess()
-
-    def _to_cfg(self, u: np.ndarray) -> Dict[str, float]:
-        return {p.name: p.from_unit(float(u[i]))
-                for i, p in enumerate(self.params)}
 
     def ask(self) -> Dict[str, float]:
         d = len(self.params)
         if len(self._xs) < self._n_init:
             return self._to_cfg(self._rng.random(d))
-        self._gp.fit(np.stack(self._xs), np.array(self._ys))
-        best = min(self._ys)
+        ys = self.fit_ys()
+        self._gp.fit(np.stack(self._xs), ys)
+        best = float(ys.min())
         cand = self._rng.random((256, d))
         mu, sigma = self._gp.predict(cand)
         imp = best - mu - self._xi
         z = imp / sigma
         ei = imp * _norm_cdf(z) + sigma * _norm_pdf(z)
         return self._to_cfg(cand[int(np.argmax(ei))])
-
-    def tell(self, cfg: Dict[str, float], y: float):
-        u = np.array([p.to_unit(cfg[p.name]) for p in self.params])
-        y = float(y)
-        if not math.isfinite(y):
-            # worst-observed substitution — see hebo.py tell()
-            finite = [v for v in self._ys if math.isfinite(v)]
-            y = (max(finite) if finite else 0.0) + 1.0
-        self._xs.append(u)
-        self._ys.append(y)
-
-    def best(self) -> Tuple[Dict[str, float], float]:
-        i = int(np.argmin(self._ys))
-        return self._to_cfg(self._xs[i]), self._ys[i]

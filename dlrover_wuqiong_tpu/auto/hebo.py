@@ -33,7 +33,13 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .bo import Param, _norm_cdf, _norm_pdf
+from .bo import (
+    AskTellBase,
+    Param,
+    _norm_cdf,
+    _norm_pdf,
+    jittered_cholesky,
+)
 
 __all__ = ["HEBO", "Param"]
 
@@ -104,14 +110,7 @@ class _WarpedGP:
         yn = (y - self._mean) / self._std
         k = _ard_rbf(self._xw, self._xw, self.ls)
         k[np.diag_indices_from(k)] += self.noise
-        jitter = 0.0
-        chol = None
-        for _ in range(8):
-            try:
-                chol = np.linalg.cholesky(k + jitter * np.eye(len(k)))
-                break
-            except np.linalg.LinAlgError:
-                jitter = max(1e-10, jitter * 10 or 1e-10)
+        chol = jittered_cholesky(k)
         if chol is None:
             return -np.inf
         self._chol = chol
@@ -142,20 +141,17 @@ def _pareto_front(scores: np.ndarray) -> np.ndarray:
     return np.nonzero(keep)[0]
 
 
-class HEBO:
+class HEBO(AskTellBase):
     """Minimize a black-box objective; ask(n) returns a diverse batch."""
 
     def __init__(self, params: Sequence[Param], seed: int = 0,
                  n_init: int = 5, fit_budget: int = 24,
                  n_candidates: int = 512, ucb_beta: float = 2.0):
-        self.params = list(params)
-        self._rng = np.random.default_rng(seed)
+        super().__init__(params, seed)
         self._n_init = n_init
         self._fit_budget = fit_budget
         self._n_cand = n_candidates
         self._beta = ucb_beta
-        self._xs: List[np.ndarray] = []
-        self._ys: List[float] = []
         self._gp: Optional[_WarpedGP] = None
 
     # ------------------------------------------------------------ surrogate
@@ -184,10 +180,6 @@ class HEBO:
 
     # ------------------------------------------------------------- ask/tell
 
-    def _to_cfg(self, u: np.ndarray) -> Dict[str, float]:
-        return {p.name: p.from_unit(float(u[i]))
-                for i, p in enumerate(self.params)}
-
     def ask(self, n: int = 1):
         """One config (n=1) or a batch list from the MACE Pareto front."""
         d = len(self.params)
@@ -195,7 +187,7 @@ class HEBO:
             out = [self._to_cfg(self._rng.random(d)) for _ in range(n)]
             return out[0] if n == 1 else out
         x = np.stack(self._xs)
-        yt, _, _ = _power_transform(np.array(self._ys))
+        yt, _, _ = _power_transform(self.fit_ys())
         self._gp = self._fit_surrogate(x, yt)
         best = float(yt.min())
 
@@ -216,22 +208,7 @@ class HEBO:
         # rank the front by EI; batch = top-n front points, topped up with
         # EI-ranked non-front candidates if the front is small
         front = front[np.argsort(-ei[front])]
-        order = list(front) + [i for i in np.argsort(-ei)
-                               if i not in set(front)]
+        fs = set(front)
+        order = list(front) + [i for i in np.argsort(-ei) if i not in fs]
         picks = [self._to_cfg(cand[i]) for i in order[:n]]
         return picks[0] if n == 1 else picks
-
-    def tell(self, cfg: Dict[str, float], y: float):
-        u = np.array([p.to_unit(cfg[p.name]) for p in self.params])
-        y = float(y)
-        if not math.isfinite(y):
-            # a diverged trial (nan/inf loss) reports as "worst observed":
-            # one NaN would otherwise poison every GP fit's likelihood
-            finite = [v for v in self._ys if math.isfinite(v)]
-            y = (max(finite) if finite else 0.0) + 1.0
-        self._xs.append(u)
-        self._ys.append(y)
-
-    def best(self) -> Tuple[Dict[str, float], float]:
-        i = int(np.argmin(self._ys))
-        return self._to_cfg(self._xs[i]), self._ys[i]

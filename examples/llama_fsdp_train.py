@@ -1,0 +1,52 @@
+"""Llama under auto_accelerate: tp x fsdp with a selective remat policy.
+
+Parity: reference `examples/pytorch/llama2/fine_tuning.py` — the
+one-call acceleration path on a Llama-family model.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# honor JAX_PLATFORMS even where a sitecustomize pre-configures another
+# platform (jax.config beats the env var in-process — CLAUDE.md rule)
+if os.environ.get("JAX_PLATFORMS"):
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--tp", type=int, default=2)
+    args = ap.parse_args()
+
+    from dlrover_wuqiong_tpu.auto.accelerate import auto_accelerate
+    from dlrover_wuqiong_tpu.models.llama import Llama, LlamaConfig
+
+    cfg = LlamaConfig.nano()  # swap for llama3_8b() on a real pod
+    res = auto_accelerate(
+        Llama(cfg), optimizer=optax.adamw(1e-3),
+        strategy=[("tensor_parallel", {"size": args.tp}),
+                  ("fsdp", {}),
+                  ("checkpoint", {"policy": "dots"})])
+    key = jax.random.PRNGKey(0)
+    data = jax.random.randint(key, (8, 33), 0, cfg.vocab_size)
+    batch = res.place_batch({"input_ids": data[:, :-1],
+                             "labels": data[:, 1:]})
+    state = res.state
+    for i in range(args.steps):
+        state, m = res.train_step(state, batch)
+        print(f"step {i + 1} loss={float(m['loss']):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""Mini-RLHF: PPO with the hybrid train/decode-mesh engine.
+
+Parity: reference atorch RL examples (`atorch/examples/rl/`) — reward
+climbs as PPO pushes the policy toward emitting a target token; rollouts
+run on a tp-only decode mesh fed by a timed weight sync.
+"""
+
+import dataclasses
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# honor JAX_PLATFORMS even where a sitecustomize pre-configures another
+# platform (jax.config beats the env var in-process — CLAUDE.md rule)
+if os.environ.get("JAX_PLATFORMS"):
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    from dlrover_wuqiong_tpu.models.gpt import GPTConfig
+    from dlrover_wuqiong_tpu.rl import PPOConfig, PPOTrainer
+
+    cfg = dataclasses.replace(
+        GPTConfig(vocab_size=64, n_layer=2, n_head=2, n_embd=32,
+                  block_size=64, dtype=jnp.float32,
+                  use_flash_attention=False, remat=False))
+    TARGET = 7
+
+    def reward_fn(tokens, prompt_len):
+        resp = tokens[:, prompt_len:]
+        return (resp == TARGET).mean(axis=1).astype(np.float32) * 4.0
+
+    n = len(jax.devices())
+    trainer = PPOTrainer(
+        cfg, PPOConfig(lr=1e-3, max_new_tokens=8, ppo_epochs=4,
+                       kl_coef=0.002),
+        reward_fn, devices=jax.devices(),
+        decode_tp=2 if n % 2 == 0 and n > 1 else 1)
+    prompts = jnp.ones((32, 4), jnp.int32)
+    for i in range(10):
+        out = trainer.step(prompts)
+        print(f"iter {i}: reward={out['reward']:.3f} "
+              f"kl={out['kl']:.4f} sync={out.get('weight_sync_s', 0):.3f}s")
+
+
+if __name__ == "__main__":
+    main()
