@@ -273,7 +273,14 @@ def test_jax_world_scale_up(tmp_path):
                 not (markers / "start_r0_p0.json").exists():
             _t.sleep(0.5)
         assert (markers / "start_r0_p0.json").exists()
-        _t.sleep(4.0)  # let a couple of steps commit
+        # wait for a COMMITTED checkpoint, not a fixed sleep: under CI
+        # load the solo worker can take >4s to commit its first steps,
+        # and the scale-up restart would then legitimately start from 0
+        commit_marker = ckpt_dir / "latest_checkpointed_iteration.txt"
+        deadline = _t.time() + 120
+        while _t.time() < deadline and not commit_marker.exists():
+            _t.sleep(0.5)
+        assert commit_marker.exists(), "solo worker never committed"
         agents.append(_spawn_agent(1, script, [ckpt_dir, markers, "plain"],
                                    port, env, nnodes="1:2"))
         for a in agents:
