@@ -32,6 +32,7 @@ logger = get_logger("auto_engine")
 class Candidate:
     plan: MeshPlan
     remat: bool = False
+    remat_policy: str = "full"       # ops/remat.py policy when remat is on
     pp_schedule: str = "gpipe"       # | "interleaved" (virtual stages)
     pp_virtual_stages: int = 1
     score: float = math.inf          # lower is better (estimated step s)
@@ -56,7 +57,10 @@ class Candidate:
         if self.plan.dp > 1:
             out.append(("data_parallel", {"size": self.plan.dp}))
         out.append(("fsdp", {"size": self.plan.fsdp}))
-        out.append(("checkpoint", {"enabled": self.remat}))
+        ckpt: Dict = {"enabled": self.remat}
+        if self.remat and self.remat_policy != "full":
+            ckpt["policy"] = self.remat_policy
+        out.append(("checkpoint", ckpt))
         return out
 
 
@@ -87,14 +91,20 @@ def generate_candidates(num_devices: int, n_head: int = 0,
                     continue
                 remaining = num_devices // (tp * pp * ep)
                 plan = MeshPlan(tp=tp, pp=pp, ep=ep, fsdp=remaining)
-                remats = (False, True) if with_remat else (False,)
-                for remat in remats:
-                    out.append(Candidate(plan=plan, remat=remat))
+                # remat variants: off, full recompute, and the selective
+                # "dots" policy (save matmul outputs) — the compile-and-
+                # score pass ranks the memory/time trade for real
+                variants = ([(False, "full"), (True, "full"),
+                             (True, "dots")] if with_remat
+                            else [(False, "full")])
+                for remat, policy in variants:
+                    out.append(Candidate(plan=plan, remat=remat,
+                                         remat_policy=policy))
                     if pp > 1 and n_layer and n_layer % (pp * 2) == 0:
                         # interleaved virtual stages shrink the bubble
-                        # from (pp-1)/(M+pp-1) to (pp-1)/(2M+pp-1); the
-                        # compile-and-score pass ranks it for real
+                        # from (pp-1)/(M+pp-1) to (pp-1)/(2M+pp-1)
                         out.append(Candidate(plan=plan, remat=remat,
+                                             remat_policy=policy,
                                              pp_schedule="interleaved",
                                              pp_virtual_stages=2))
     return out
@@ -211,7 +221,7 @@ def search_strategy(model, optimizer, sample_batch: Dict,
         sched = ("" if c.plan.pp <= 1 or c.pp_schedule == "gpipe"
                  else f" {c.pp_schedule}v{c.pp_virtual_stages}")
         logger.info("  %s%s remat=%s → %s", c.plan.describe(), sched,
-                    c.remat,
+                    c.remat_policy if c.remat else "off",
                     f"score={c.score:.4g}" if c.feasible
                     else f"infeasible ({c.reason[:60]})")
     feasible = [c for c in cands if c.feasible]

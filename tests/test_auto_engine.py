@@ -35,10 +35,15 @@ class TestCandidateGeneration:
         assert all(c.plan.tp in (1, 2, 4) for c in cands)
         assert any(c.plan.pp == 2 for c in cands)
 
-    def test_remat_doubles_space(self):
+    def test_remat_triples_space(self):
+        # off / full-remat / selective-dots per mesh plan
         a = generate_candidates(4, with_remat=False)
         b = generate_candidates(4, with_remat=True)
-        assert len(b) == 2 * len(a)
+        assert len(b) == 3 * len(a)
+        assert any(c.remat and c.remat_policy == "dots" for c in b)
+        strat = dict(next(c for c in b if c.remat_policy == "dots"
+                          and c.remat).strategy())
+        assert strat["checkpoint"] == {"enabled": True, "policy": "dots"}
 
     def test_strategy_roundtrip(self):
         c = Candidate(plan=MeshPlan(tp=2, fsdp=4), remat=True)
