@@ -333,10 +333,10 @@ def auto_accelerate(
         # stage-sliced GPipe pipeline over the pp axis (parallel/pipeline.py)
         from ..parallel.pipeline import PipelinedLM, PipelineShardingPlanner
 
-        if ctx.plan.sp > 1 and sp_impl != "gspmd":
-            raise ValueError(
-                "pipeline_parallel does not compose with ring/ulysses "
-                "sequence parallel yet — use impl='gspmd' or drop one")
+        # pp x ring/ulysses SP composes: the attention's inner shard_map
+        # nests inside the pipeline's manual-pp body via the context
+        # AbstractMesh with VMA tracking (parallel/long_context.py
+        # _context_mesh) — the long-context 70B configuration's layout
         # (MoE composes with every schedule incl. 1f1b — the manual
         # backward seeds the router aux cotangent, parallel/pipeline.py)
         n_layer = getattr(model.config, "n_layer",
@@ -361,8 +361,10 @@ def auto_accelerate(
             # many-GB) init_params below burn work on a doomed config
             raise ValueError(
                 "local_sgd does not compose with pipeline_parallel — the "
-                "DiLoCo step is manual over dp while the pipeline is "
-                "manual over pp, and the two shard_maps cannot nest")
+                "DiLoCo step and the pipeline are both manual over the "
+                "data-carrying axes and their stacked-replica/stage "
+                "param layouts conflict (ring/ulysses SP nests fine; "
+                "this pair does not)")
         model = PipelinedLM(model, mesh, microbatches,
                             schedule=pp_schedule,
                             virtual_stages=pp_virtual)

@@ -41,14 +41,41 @@ try:  # moved out of jax.experimental in newer versions
     from jax import shard_map as _raw_shard_map  # type: ignore
 
     def shard_map(f, mesh, in_specs, out_specs):
-        return _raw_shard_map(f, mesh=mesh, in_specs=in_specs,
-                              out_specs=out_specs, check_vma=False)
+        return _raw_shard_map(f, mesh=_context_mesh(mesh),
+                              in_specs=in_specs,
+                              out_specs=out_specs, check_vma=True)
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _raw_shard_map
 
     def shard_map(f, mesh, in_specs, out_specs):
-        return _raw_shard_map(f, mesh=mesh, in_specs=in_specs,
+        # legacy jax: no get_abstract_mesh, so pp-nesting cannot happen —
+        # keep check_rep=False (True would reject the Pallas custom-VJP
+        # kernels that lack replication rules on that version)
+        return _raw_shard_map(f, mesh=_context_mesh(mesh),
+                              in_specs=in_specs,
                               out_specs=out_specs, check_rep=False)
+
+
+def _context_mesh(mesh: "Mesh"):
+    """The mesh a NESTED shard_map must use.
+
+    Inside another shard_map (e.g. the pipeline's manual-pp body calling
+    ring/Ulysses attention), jax requires the inner shard_map's mesh to be
+    the CONTEXT AbstractMesh — whose already-manual axes (pp) are marked —
+    not the original all-Auto concrete mesh.  Outside any manual context
+    the concrete mesh passes through unchanged, which is what makes
+    pp x ring/ulysses SP composable with one wrapper."""
+    try:
+        from jax.sharding import get_abstract_mesh
+
+        ctx = get_abstract_mesh()
+        if ctx is not None and getattr(ctx, "axis_names", None) and \
+                any("manual" in str(t).lower() for t in
+                    getattr(ctx, "axis_types", ())):
+            return ctx
+    except ImportError:  # pragma: no cover — older jax
+        pass
+    return mesh
 
 
 _BATCH_AXES = ("dp", "fsdp")  # mesh data axes (parallel/mesh.py AXIS_ORDER)
