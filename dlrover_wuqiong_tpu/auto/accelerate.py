@@ -361,10 +361,11 @@ def auto_accelerate(
             # many-GB) init_params below burn work on a doomed config
             raise ValueError(
                 "local_sgd does not compose with pipeline_parallel — the "
-                "DiLoCo step and the pipeline are both manual over the "
-                "data-carrying axes and their stacked-replica/stage "
-                "param layouts conflict (ring/ulysses SP nests fine; "
-                "this pair does not)")
+                "pipeline's PARTIALLY-manual shard_map ({pp} with other "
+                "axes GSPMD) cannot nest under the DiLoCo dp-manual body: "
+                "the partitioner rejects re-binding the parent's dp axis "
+                "(ring/ulysses SP nests fine because it goes FULLY manual "
+                "inside)")
         model = PipelinedLM(model, mesh, microbatches,
                             schedule=pp_schedule,
                             virtual_stages=pp_virtual)

@@ -57,25 +57,11 @@ except ImportError:  # pragma: no cover
 
 
 def _context_mesh(mesh: "Mesh"):
-    """The mesh a NESTED shard_map must use.
+    """Nested-shard_map mesh resolution — see parallel/mesh.py
+    context_mesh (shared with the pipeline)."""
+    from .mesh import context_mesh
 
-    Inside another shard_map (e.g. the pipeline's manual-pp body calling
-    ring/Ulysses attention), jax requires the inner shard_map's mesh to be
-    the CONTEXT AbstractMesh — whose already-manual axes (pp) are marked —
-    not the original all-Auto concrete mesh.  Outside any manual context
-    the concrete mesh passes through unchanged, which is what makes
-    pp x ring/ulysses SP composable with one wrapper."""
-    try:
-        from jax.sharding import get_abstract_mesh
-
-        ctx = get_abstract_mesh()
-        if ctx is not None and getattr(ctx, "axis_names", None) and \
-                any("manual" in str(t).lower() for t in
-                    getattr(ctx, "axis_types", ())):
-            return ctx
-    except ImportError:  # pragma: no cover — older jax
-        pass
-    return mesh
+    return context_mesh(mesh)
 
 
 _BATCH_AXES = ("dp", "fsdp")  # mesh data axes (parallel/mesh.py AXIS_ORDER)

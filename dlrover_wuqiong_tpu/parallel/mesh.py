@@ -159,3 +159,30 @@ def hybrid_slice_plan(num_slices: int, devices_per_slice: int,
     a slice so heavy collectives stay on ICI (SURVEY.md §2.5 TPU row)."""
     inner = devices_per_slice // (tp * sp)
     return MeshPlan(dp=num_slices, fsdp=inner, tp=tp, sp=sp)
+
+
+def context_mesh(mesh):
+    """The mesh a NESTED shard_map must target.
+
+    Inside another shard_map (manual axes active), jax requires the inner
+    shard_map's mesh to be the context AbstractMesh — whose already-manual
+    axes are marked — not the original all-Auto concrete mesh.  Outside
+    any manual context the concrete mesh passes through unchanged.  Used
+    by parallel/long_context.py (ring/Ulysses inside the pipeline) and
+    parallel/pipeline.py (pipeline inside the DiLoCo dp body).
+    """
+    try:
+        from jax.sharding import get_abstract_mesh
+    except ImportError:  # pragma: no cover — legacy jax: no nesting
+        return mesh
+    ctx = get_abstract_mesh()
+    if ctx is not None and getattr(ctx, "axis_names", None) and \
+            any("manual" in str(t).lower() for t in
+                getattr(ctx, "axis_types", ())):
+        return ctx
+    return mesh
+
+
+def in_manual_context() -> bool:
+    """True when tracing inside a shard_map with manual axes."""
+    return context_mesh(None) is not None

@@ -57,13 +57,34 @@ except ImportError:  # pragma: no cover
     _shard_map = None
 
 
+def _pvary_pp(tree):
+    """Mark a scan carry as pp-varying for VMA-tracked (nested) contexts.
+
+    Under check_vma=True the scan carry must enter with the same varying-
+    axes type it leaves with (ppermute/axis_index make it {V:pp}); outside
+    VMA tracking pvary is a no-op."""
+    try:
+        return jax.tree.map(lambda x: jax.lax.pvary(x, ("pp",)), tree)
+    except Exception:  # noqa: BLE001 — older jax without pvary
+        return tree
+
+
 def _pp_shard_map(f, mesh, in_specs, out_specs):
-    """shard_map manual over ONLY the pp axis; other axes stay GSPMD."""
+    """shard_map manual over ONLY the pp axis; other axes stay GSPMD.
+
+    When NESTED inside another manual body (the DiLoCo dp step), the mesh
+    must be the context AbstractMesh and VMA tracking must be ON — the
+    pp x ring-SP closure showed that an inner shard_map's transpose
+    silently corrupts gradients without it (tests pin grad exactness)."""
     if _shard_map is None:  # pragma: no cover
         raise RuntimeError("pipeline parallelism needs jax.shard_map with "
                            "axis_names support (jax >= 0.6)")
-    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                      axis_names={"pp"}, check_vma=False)
+    from .mesh import context_mesh
+
+    ctx = context_mesh(mesh)
+    nested = ctx is not mesh
+    return _shard_map(f, mesh=ctx, in_specs=in_specs, out_specs=out_specs,
+                      axis_names={"pp"}, check_vma=nested)
 
 
 def schedule_ticks(schedule: str, num_microbatches: int, pp: int,
@@ -196,7 +217,7 @@ def pipeline_apply(block_fn: Callable[[Any, jax.Array], jax.Array],
         buf0 = jnp.zeros_like(xm_full[0])
         outs0 = jnp.zeros_like(xm_full)
         (_, outs, aux_acc), _ = jax.lax.scan(
-            _tick, (buf0, outs0, jnp.zeros((), jnp.float32)),
+            _tick, _pvary_pp((buf0, outs0, jnp.zeros((), jnp.float32))),
             jnp.arange(n_ticks))
         # only the last stage holds real outputs; broadcast over pp so the
         # head computes identically (and cheaply) on every stage
@@ -265,7 +286,7 @@ def _interleaved_apply(block_fn, stacked_params, xm, mesh, v):
         buf0 = jnp.zeros_like(xm_full[0])
         outs0 = jnp.zeros_like(xm_full)
         (_, outs, aux_acc), _ = jax.lax.scan(
-            _tick, (buf0, outs0, jnp.zeros((), jnp.float32)),
+            _tick, _pvary_pp((buf0, outs0, jnp.zeros((), jnp.float32))),
             jnp.arange(n_ticks))
         outs = jax.lax.psum(
             jnp.where(stage == pp - 1, outs, jnp.zeros_like(outs)), "pp")
@@ -414,10 +435,11 @@ def pipeline_1f1b(block_fn: Callable[[Any, jax.Array], jax.Array],
             bwd_buf = jax.lax.ppermute(dh_prev, "pp", bwd_perm)
             return (fwd_buf, bwd_buf, stash, d_sp, d_hp, d_xm, loss), None
 
-        carry0 = (zero_h, zero_h,
-                  jnp.zeros((S,) + xm_full[0].shape, xm_full.dtype),
-                  _tree_zeros_like(sp_local), _tree_zeros_like(hp),
-                  jnp.zeros_like(xm_full), jnp.zeros((), jnp.float32))
+        carry0 = _pvary_pp(
+            (zero_h, zero_h,
+             jnp.zeros((S,) + xm_full[0].shape, xm_full.dtype),
+             _tree_zeros_like(sp_local), _tree_zeros_like(hp),
+             jnp.zeros_like(xm_full), jnp.zeros((), jnp.float32)))
         (_, _, _, d_sp, d_hp, d_xm, loss), _ = jax.lax.scan(
             _tick, carry0, jnp.arange(n_ticks))
 

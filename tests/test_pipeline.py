@@ -554,22 +554,37 @@ class TestPipelineTraining:
                                                     atol=1e-6),
             base, sp)
 
-    def test_1f1b_ring_sp_trains(self):
+    def test_1f1b_ring_sp_grads_match_and_train(self):
+        """ring-SP inside the MANUAL 1f1b backward: gradient-exact vs
+        plain 1f1b, and training steps."""
         cfg = dataclasses.replace(GPTConfig.nano(), remat=False,
                                   use_flash_attention=False,
                                   dtype=jnp.float32)
-        res = auto_accelerate(
-            GPT(cfg), optimizer=optax.adam(1e-2),
-            strategy=[("pipeline_parallel",
-                       {"size": 2, "microbatches": 2,
-                        "schedule": "1f1b"}),
-                      ("sequence_parallel", {"size": 2, "impl": "ring"}),
-                      ("fsdp", {})],
-            devices=jax.devices()[:8])
         data = jax.random.randint(jax.random.PRNGKey(0), (8, 33), 0,
                                   cfg.vocab_size)
-        batch = res.place_batch({"input_ids": data[:, :-1],
-                                 "labels": data[:, 1:]})
+
+        def vg_of(strategy):
+            res = auto_accelerate(GPT(cfg), optimizer=optax.adam(1e-2),
+                                  strategy=strategy,
+                                  devices=jax.devices()[:8],
+                                  rng=jax.random.PRNGKey(5))
+            batch = res.place_batch({"input_ids": data[:, :-1],
+                                     "labels": data[:, 1:]})
+            loss, g = jax.jit(res.model.value_and_grad)(
+                dict(res.state.params), batch)
+            return res, batch, float(loss), jax.tree.map(np.asarray, g)
+
+        pp = [("pipeline_parallel", {"size": 2, "microbatches": 2,
+                                     "schedule": "1f1b"})]
+        _, _, l0, g0 = vg_of(pp + [("fsdp", {})])
+        res, batch, l1, g1 = vg_of(
+            pp + [("sequence_parallel", {"size": 2, "impl": "ring"}),
+                  ("fsdp", {})])
+        assert abs(l0 - l1) < 1e-5
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4,
+                                                    atol=1e-6),
+            g0, g1)
         state, losses = res.state, []
         for _ in range(4):
             state, m = res.train_step(state, batch)
