@@ -64,8 +64,12 @@ def _pvary_pp(tree):
     axes type it leaves with (ppermute/axis_index make it {V:pp}); outside
     VMA tracking pvary is a no-op."""
     try:
+        pcast = getattr(jax.lax, "pcast", None)
+        if pcast is not None:
+            return jax.tree.map(
+                lambda x: pcast(x, ("pp",), to="varying"), tree)
         return jax.tree.map(lambda x: jax.lax.pvary(x, ("pp",)), tree)
-    except Exception:  # noqa: BLE001 — older jax without pvary
+    except Exception:  # noqa: BLE001 — older jax without pcast/pvary
         return tree
 
 
