@@ -120,7 +120,8 @@ def _s_ep(ctx: StrategyContext, cfg: Dict, num_devices: int):
 @register_strategy("pipeline_parallel")
 def _s_pp(ctx: StrategyContext, cfg: Dict, num_devices: int):
     """cfg: size, microbatches, schedule ("gpipe" | "interleaved" | "1f1b"),
-    virtual_stages (interleaved chunk count per device)."""
+    virtual_stages (interleaved chunk count per device), head_loss (1f1b
+    only: per-microbatch (head_params, h, labels) -> scalar loss)."""
     ctx.plan.pp = cfg.get("size", 1)
     ctx.extra["pp_microbatches"] = cfg.get("microbatches")
     schedule = cfg.get("schedule", "gpipe")
@@ -136,6 +137,17 @@ def _s_pp(ctx: StrategyContext, cfg: Dict, num_devices: int):
                          "schedule='interleaved'")
     ctx.extra["pp_schedule"] = schedule
     ctx.extra["pp_virtual_stages"] = virtual
+    if cfg.get("head_loss") is not None:
+        if schedule != "1f1b":
+            raise ValueError(
+                "head_loss only applies to schedule='1f1b' (gpipe/"
+                "interleaved take a whole-batch loss_fn instead)")
+        if ctx.plan.pp <= 1:
+            raise ValueError(
+                "head_loss needs ('pipeline_parallel', {'size': >= 2, "
+                "...}) — with pp=1 no pipeline is built and the custom "
+                "objective would silently fall back to cross-entropy")
+        ctx.extra["pp_head_loss"] = cfg["head_loss"]
 
 
 @register_strategy("local_sgd")
@@ -353,9 +365,12 @@ def auto_accelerate(
         pp_virtual = ctx.extra.get("pp_virtual_stages", 1)
         if pp_schedule == "1f1b" and loss_fn is not None:
             raise ValueError(
-                "pipeline schedule '1f1b' computes its own head loss "
-                "(cross-entropy) inside the schedule and cannot honor a "
-                "custom loss_fn — use schedule='gpipe'/'interleaved'")
+                "pipeline schedule '1f1b' cannot honor a whole-batch "
+                "(params, batch) loss_fn — its backward seeds PER-"
+                "MICROBATCH head vjps in-schedule.  Pass a per-microbatch "
+                "head loss instead: ('pipeline_parallel', {'head_loss': "
+                "fn(head_params, h, labels) -> scalar}), or use "
+                "schedule='gpipe'/'interleaved'")
         if ctx.extra.get("local_sgd") is not None:
             # reject HERE, before PipelinedLM wrapping and the (possibly
             # many-GB) init_params below burn work on a doomed config
@@ -368,7 +383,8 @@ def auto_accelerate(
                 "inside)")
         model = PipelinedLM(model, mesh, microbatches,
                             schedule=pp_schedule,
-                            virtual_stages=pp_virtual)
+                            virtual_stages=pp_virtual,
+                            head_loss_fn=ctx.extra.get("pp_head_loss"))
         planner = PipelineShardingPlanner(planner)
         logger.info("pipeline parallel: %d stages x %d layers, %d "
                     "microbatches, schedule=%s%s", ctx.plan.pp,

@@ -63,13 +63,16 @@ def _pvary_pp(tree):
     Under check_vma=True the scan carry must enter with the same varying-
     axes type it leaves with (ppermute/axis_index make it {V:pp}); outside
     VMA tracking pvary is a no-op."""
-    try:
-        pcast = getattr(jax.lax, "pcast", None)
-        if pcast is not None:
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is not None:
+        try:
             return jax.tree.map(
                 lambda x: pcast(x, ("pp",), to="varying"), tree)
+        except Exception:  # noqa: BLE001 — fall through to pvary
+            pass
+    try:
         return jax.tree.map(lambda x: jax.lax.pvary(x, ("pp",)), tree)
-    except Exception:  # noqa: BLE001 — older jax without pcast/pvary
+    except Exception:  # noqa: BLE001 — older jax without either
         return tree
 
 
@@ -525,6 +528,12 @@ class PipelinedLM:
     head_fn: Optional[Callable] = None       # (head_params, h) -> logits
     embed_keys: Optional[Tuple[str, ...]] = None
     head_keys: Optional[Tuple[str, ...]] = None
+    # custom PER-MICROBATCH head loss for the 1f1b schedule:
+    # (head_params, h (b,T,C), labels (b,T)) -> scalar mean loss.  This is
+    # the shape 1f1b can honor (its backward seeds per-microbatch head
+    # vjps in-schedule); a whole-batch (params, batch) loss_fn cannot be
+    # decomposed that way and stays rejected.
+    head_loss_fn: Optional[Callable] = None
     # does block_fn return (h, aux)?  None = derive: MoE configs using the
     # built-in adapters do; custom block_builders must say so explicitly
     # (a silent zero aux would hide a dropped balance loss)
@@ -534,6 +543,11 @@ class PipelinedLM:
         self.config = self.inner.config
         self._n_layer = getattr(self.config, "n_layer",
                                 getattr(self.config, "num_layers", 0))
+        if self.head_loss_fn is not None and self.schedule != "1f1b":
+            raise ValueError(
+                "head_loss_fn only applies to schedule='1f1b' — gpipe/"
+                "interleaved train through a whole-batch loss_fn and "
+                "would silently ignore it")
         if getattr(self.config, "moe_experts", 0) and \
                 self.block_builder is not None and \
                 self.block_returns_aux is None:
@@ -617,7 +631,8 @@ class PipelinedLM:
     def value_and_grad(self, params: Dict, batch: Dict
                        ) -> Tuple[jax.Array, Dict]:
         """(loss, grads) via the 1F1B schedule — used by make_train_step in
-        place of jax.value_and_grad when schedule == "1f1b"."""
+        place of jax.value_and_grad when schedule == "1f1b".  The head
+        loss is `head_loss_fn` when supplied, else token cross-entropy."""
         from ..models.gpt import cross_entropy_loss
 
         idx, labels = batch["input_ids"], batch["labels"]
@@ -633,8 +648,11 @@ class PipelinedLM:
         lm = labels.reshape(M, B // M, T)
         block_fn = self._block_fn(params, idx, True)
 
-        def head_loss(hparams, h, lbl):
-            return cross_entropy_loss(self._head(hparams, h), lbl)
+        if self.head_loss_fn is not None:
+            head_loss = self.head_loss_fn
+        else:
+            def head_loss(hparams, h, lbl):
+                return cross_entropy_loss(self._head(hparams, h), lbl)
 
         loss, d_blocks, d_head, d_xm = pipeline_1f1b(
             block_fn, head_loss, params["blocks"], hp, xm, lm, self.mesh)

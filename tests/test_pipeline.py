@@ -686,3 +686,96 @@ class TestPipelineTraining:
             auto_accelerate(GPT(cfg),
                             strategy=[("pipeline_parallel", {"size": 3})],
                             devices=jax.devices()[:3])
+
+
+class TestOneFOneBCustomHeadLoss:
+    """1f1b x custom loss (round-4 partial closure): a PER-MICROBATCH
+    head loss — the shape the in-schedule backward can seed — threads
+    through ('pipeline_parallel', {'head_loss': fn}); whole-batch
+    loss_fn stays rejected with a message pointing here."""
+
+    def test_label_smoothed_head_loss_matches_gpipe_equivalent(self):
+        import flax.linen as nn
+
+        cfg = dataclasses.replace(GPTConfig.nano(), remat=False,
+                                  use_flash_attention=False,
+                                  dtype=jnp.float32)
+        data = jax.random.randint(jax.random.PRNGKey(0), (8, 33), 0,
+                                  cfg.vocab_size)
+        EPS = 0.1
+
+        def smoothed_ce_from_logits(logits, labels):
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+            nll = -jnp.take_along_axis(logp, labels[..., None],
+                                       -1)[..., 0]
+            uniform = -logp.mean(-1)
+            return ((1 - EPS) * nll + EPS * uniform).mean()
+
+        def head_loss(hp, h, labels):
+            x = nn.LayerNorm(dtype=cfg.dtype).apply({"params": hp["ln_f"]},
+                                                    h)
+            logits = jnp.einsum("bte,ve->btv", x,
+                                hp["wte"]["embedding"].astype(cfg.dtype))
+            return smoothed_ce_from_logits(logits, labels)
+
+        res = auto_accelerate(
+            GPT(cfg), optimizer=optax.sgd(0.0),
+            strategy=[("pipeline_parallel",
+                       {"size": 2, "microbatches": 2, "schedule": "1f1b",
+                        "head_loss": head_loss}), ("fsdp", {})],
+            devices=jax.devices()[:8], rng=jax.random.PRNGKey(5))
+        batch = res.place_batch({"input_ids": data[:, :-1],
+                                 "labels": data[:, 1:]})
+        loss_1f1b, g_1f1b = jax.jit(res.model.value_and_grad)(
+            dict(res.state.params), batch)
+
+        # gpipe equivalent: whole-batch custom loss over the same model
+        def whole_batch_loss(params, batch):
+            logits = res_g.model.apply({"params": params},
+                                       batch["input_ids"])
+            return smoothed_ce_from_logits(logits, batch["labels"])
+
+        res_g = auto_accelerate(
+            GPT(cfg), optimizer=optax.sgd(0.0),
+            strategy=[("pipeline_parallel",
+                       {"size": 2, "microbatches": 2}), ("fsdp", {})],
+            devices=jax.devices()[:8], rng=jax.random.PRNGKey(5))
+        batch_g = res_g.place_batch({"input_ids": data[:, :-1],
+                                     "labels": data[:, 1:]})
+        loss_g, g_g = jax.jit(jax.value_and_grad(whole_batch_loss))(
+            dict(res_g.state.params), batch_g)
+        np.testing.assert_allclose(float(loss_1f1b), float(loss_g),
+                                   atol=1e-5)
+        # both grads are in the pipelined {blocks, ...} layout
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+            g_1f1b, g_g)
+
+    def test_whole_batch_loss_fn_still_rejected_with_pointer(self):
+        cfg = dataclasses.replace(GPTConfig.nano(), remat=False)
+        with pytest.raises(ValueError, match="head_loss"):
+            auto_accelerate(
+                GPT(cfg), loss_fn=lambda p, b: 0.0,
+                strategy=[("pipeline_parallel",
+                           {"size": 2, "schedule": "1f1b"})],
+                devices=jax.devices()[:2])
+
+    def test_head_loss_outside_1f1b_rejected(self):
+        cfg = dataclasses.replace(GPTConfig.nano(), remat=False)
+        with pytest.raises(ValueError, match="1f1b"):
+            auto_accelerate(
+                GPT(cfg),
+                strategy=[("pipeline_parallel",
+                           {"size": 2, "head_loss": lambda *a: 0.0})],
+                devices=jax.devices()[:2])
+
+    def test_head_loss_with_pp1_rejected(self):
+        cfg = dataclasses.replace(GPTConfig.nano(), remat=False)
+        with pytest.raises(ValueError, match="size"):
+            auto_accelerate(
+                GPT(cfg),
+                strategy=[("pipeline_parallel",
+                           {"schedule": "1f1b",
+                            "head_loss": lambda *a: 0.0})],
+                devices=jax.devices()[:2])
