@@ -1,6 +1,12 @@
 """RLHF engine: KV-cache generation + PPO (reference atorch/rl parity)."""
 
 from .generation import SampleConfig, generate
+from .reward import (
+    RewardModel,
+    RewardModelTrainer,
+    as_reward_fn,
+    bradley_terry_loss,
+)
 from .ppo import (
     ActorCritic,
     PPOConfig,
@@ -11,6 +17,10 @@ from .ppo import (
 )
 
 __all__ = [
+    "RewardModel",
+    "RewardModelTrainer",
+    "as_reward_fn",
+    "bradley_terry_loss",
     "SampleConfig",
     "generate",
     "ActorCritic",

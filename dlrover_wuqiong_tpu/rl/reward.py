@@ -1,0 +1,98 @@
+"""Reward-model role: scoring head + Bradley-Terry preference training.
+
+Parity: reference `atorch/atorch/rl/model_engine/model_engine.py:98,475` —
+the engine auto-accelerates "reward_model"/"cost_model" roles alongside
+actor/critic/ref, and rollouts score responses through them.  Here the
+role is a flax module (GPT trunk + scalar head reading the LAST response
+token), a pairwise trainer (Bradley-Terry: -log sigmoid(r_chosen -
+r_rejected), the standard RLHF-RM objective), and an adapter producing
+exactly the `reward_fn(tokens, prompt_len) -> (B,)` signature
+`PPOTrainer` consumes — train a RM on preferences, plug it straight into
+PPO.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..models.gpt import GPT, GPTConfig
+
+
+class RewardModel(nn.Module):
+    """GPT trunk + scalar reward head on the final token's hidden state."""
+
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, tokens) -> jax.Array:
+        _, hidden = GPT(self.config, name="gpt")(tokens, return_hidden=True)
+        scores = nn.Dense(1, dtype=jnp.float32, name="reward_head")(
+            hidden.astype(jnp.float32))[..., 0]      # (B, T)
+        return scores[:, -1]                          # (B,)
+
+    def init_params(self, rng, batch: int = 1, seq: int = 8):
+        return self.init(rng, jnp.zeros((batch, seq), jnp.int32))["params"]
+
+
+def bradley_terry_loss(model: RewardModel, params, chosen, rejected):
+    """-log sigmoid(r_chosen - r_rejected), plus pairwise accuracy."""
+    r_c = model.apply({"params": params}, chosen)
+    r_r = model.apply({"params": params}, rejected)
+    margin = r_c - r_r
+    loss = -jax.nn.log_sigmoid(margin).mean()
+    acc = (margin > 0).mean()
+    return loss, acc
+
+
+@dataclasses.dataclass
+class RewardModelTrainer:
+    """Minimal pairwise-preference trainer for the RM role.
+
+    `step(chosen, rejected)` consumes token batches of equal shape
+    (B, T); chosen[i] is preferred over rejected[i].
+    """
+
+    model: RewardModel
+    lr: float = 1e-4
+    seed: int = 0
+
+    def __post_init__(self):
+        self.params = self.model.init_params(jax.random.PRNGKey(self.seed))
+        self.opt = optax.adam(self.lr)
+        self.opt_state = self.opt.init(self.params)
+
+        @jax.jit
+        def _step(params, opt_state, chosen, rejected):
+            (loss, acc), grads = jax.value_and_grad(
+                lambda p: bradley_terry_loss(self.model, p, chosen,
+                                             rejected),
+                has_aux=True)(params)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss, \
+                acc
+
+        self._step = _step
+
+    def step(self, chosen, rejected) -> Dict[str, float]:
+        self.params, self.opt_state, loss, acc = self._step(
+            self.params, self.opt_state, jnp.asarray(chosen),
+            jnp.asarray(rejected))
+        return {"loss": float(loss), "pairwise_acc": float(acc)}
+
+
+def as_reward_fn(model: RewardModel, params):
+    """Adapter: trained RM -> the reward_fn signature PPOTrainer takes."""
+    score = jax.jit(lambda p, t: model.apply({"params": p}, t))
+
+    def reward_fn(tokens: np.ndarray, prompt_len: int) -> np.ndarray:
+        return np.asarray(score(params, jnp.asarray(tokens)),
+                          np.float32)
+
+    return reward_fn
