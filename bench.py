@@ -187,13 +187,18 @@ def main():
         ck.wait_latest_checkpoint(600)
         # restore path (north star: restore < 30 s): full load of the
         # committed checkpoint back onto the live state's shardings
+        from dlrover_wuqiong_tpu.common.util import sync_tree
+
+        # warm: compile the all-leaf sync reduction on a same-structure
+        # tree so the timed window below pays one dispatch, not a compile
+        sync_tree(state._asdict())
         t0 = time.perf_counter()
         restored = ck.load_checkpoint(state._asdict())
         assert restored is not None
-        # host readback: the batched device_put is async and
-        # block_until_ready is a no-op over the tunnel
-        float(jnp.float32(
-            jax.tree.leaves(restored)[1].reshape(-1)[0]))
+        # all-leaf readback: the batched device_put is async,
+        # block_until_ready is a no-op over the tunnel, and a single-leaf
+        # probe only lower-bounds the restore (r4 verdict weak #2)
+        sync_tree(restored)
         side["restore_s"] = round(time.perf_counter() - t0, 3)
         del restored
         ck.close()

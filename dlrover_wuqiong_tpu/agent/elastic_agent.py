@@ -93,6 +93,7 @@ class ElasticAgent:
         self.worker_env = worker_env or {}
         self._worker: Optional[WorkerContext] = None
         self._restart_count = 0
+        self._rollback_before = -1  # loss-spike resume ceiling (one-shot)
         self._stopped = threading.Event()
         self._saver: Optional[AsyncCheckpointSaver] = None
         self._heartbeat_thread: Optional[threading.Thread] = None
@@ -229,6 +230,11 @@ class ElasticAgent:
             NodeEnv.LOCAL_DEVICE_COUNT: str(outcome.local_world_size),
             NodeEnv.RESTART_COUNT: str(self._restart_count),
         })
+        if self._rollback_before >= 0:
+            # one-shot: the relaunched worker resumes from the newest
+            # committed ckpt BEFORE the spike step, then the ceiling clears
+            env[NodeEnv.ROLLBACK_BEFORE_STEP] = str(self._rollback_before)
+            self._rollback_before = -1
         stdout = None
         if self.config.log_dir:
             os.makedirs(self.config.log_dir, exist_ok=True)
@@ -322,10 +328,21 @@ class ElasticAgent:
         def _loop():
             while not self._stopped.wait(JobConstant.HEARTBEAT_INTERVAL_SECS):
                 try:
-                    action = self.mc.report_heart_beat()
-                    if action == "restart" and self._worker is not None:
-                        logger.info("master requested worker restart")
-                        self._stop_worker()
+                    resp = self.mc.report_heart_beat_full()
+                    if resp.action == "restart":
+                        # capture the ceiling BEFORE the worker-liveness
+                        # check: the master clears it one-shot, and it must
+                        # not be lost to a restart-in-progress race
+                        if resp.rollback_before_step >= 0:
+                            # loss-spike rollback: the relaunched worker must
+                            # resume from a ckpt BEFORE the spike (ADVICE r4
+                            # — the latest commit may postdate spike onset)
+                            self._rollback_before = resp.rollback_before_step
+                        if self._worker is not None:
+                            logger.info("master requested worker restart"
+                                        " (rollback_before=%d)",
+                                        resp.rollback_before_step)
+                            self._stop_worker()
                 except Exception:  # noqa: BLE001
                     logger.warning("heartbeat failed", exc_info=True)
 

@@ -124,10 +124,14 @@ class MasterClient:
             accelerator_num=accelerator_num))
 
     def report_heart_beat(self, global_step: int = 0) -> str:
-        resp = self._client.report(msg.HeartBeat(
+        return self.report_heart_beat_full(global_step).action
+
+    def report_heart_beat_full(self, global_step: int = 0
+                               ) -> msg.HeartbeatResponse:
+        """Full response — carries rollback_before_step for spike rollbacks."""
+        return self._client.report(msg.HeartBeat(
             node_id=self.node_id, timestamp=time.time(),
             global_step=global_step))
-        return resp.action
 
     def report_failure(self, error_data: str, restart_count: int = 0,
                        level: str = "process"):

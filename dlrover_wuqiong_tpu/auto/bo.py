@@ -117,6 +117,9 @@ class GaussianProcess:
         k = _rbf(self._x, self._x, self.ls)
         k[np.diag_indices_from(k)] += self.noise
         self._chol = jittered_cholesky(k)
+        if self._chol is None:  # never-PD kernel even with max jitter
+            self._alpha = None  # ask() falls back to random suggestions
+            return
         self._alpha = np.linalg.solve(
             self._chol.T, np.linalg.solve(self._chol, yn))
 
@@ -161,6 +164,10 @@ class BayesianOptimizer(AskTellBase):
             return self._to_cfg(self._rng.random(d))
         ys = self.fit_ys()
         self._gp.fit(np.stack(self._xs), ys)
+        if self._gp._chol is None:
+            # kernel never became PD (e.g. duplicated points with tiny
+            # noise) — a random probe beats an AttributeError (ADVICE r4)
+            return self._to_cfg(self._rng.random(d))
         best = float(ys.min())
         cand = self._rng.random((256, d))
         mu, sigma = self._gp.predict(cand)

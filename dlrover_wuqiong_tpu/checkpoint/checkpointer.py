@@ -54,8 +54,29 @@ class FlashCheckpointer:
 
     def load_checkpoint(self, template: Any,
                         path: Optional[str] = None,
-                        step: Optional[int] = None) -> Optional[Any]:
-        """Restore into `template`'s structure/shardings; None if no ckpt."""
+                        step: Optional[int] = None,
+                        before_step: Optional[int] = None) -> Optional[Any]:
+        """Restore into `template`'s structure/shardings; None if no ckpt.
+
+        `before_step`: resume from the newest committed step strictly
+        preceding it (loss-spike rollback — the tracker's latest commit can
+        postdate spike onset).  Ignored when `step` is given explicitly.
+        """
+        if step is None and before_step is not None:
+            prior = [s for s in self.engine.committed_steps(path)
+                     if s < before_step]
+            if not prior:
+                logger.warning(
+                    "rollback: no committed step precedes %d — "
+                    "falling back to the latest checkpoint", before_step)
+            else:
+                step = prior[-1]
+                logger.info("rollback: resuming from committed step %d "
+                            "(< spike step %d)", step, before_step)
+                # make the rollback durable: discard the post-spike
+                # lineage so a crash BEFORE the rolled-back run commits
+                # fresh cannot resume from a poisoned checkpoint
+                self.engine.demote_steps_after(step, path)
         flat = self.engine.load(path, step)
         if flat is None:
             return None

@@ -409,6 +409,11 @@ class AsyncCheckpointSaver:
         deadline = time.time() + timeout
         while time.time() < deadline:
             if len(self.storage.listdir(done_dir)) >= expected:
+                # marker BEFORE tracker: a step is only selectable by
+                # rollback's committed_steps() once every shard landed —
+                # done-files alone can be a partial set (crash mid-flush)
+                self.storage.write(str(step), os.path.join(
+                    sdir, CheckpointConstant.COMMIT_MARKER))
                 tracker = os.path.join(path,
                                        CheckpointConstant.TRACKER_FILE)
                 self.storage.write(str(step), tracker)

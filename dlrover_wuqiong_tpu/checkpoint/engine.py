@@ -323,6 +323,12 @@ class CheckpointEngine:
                     count=int(np.prod(t["shape"])) if t["shape"] else 1,
                     offset=t["file_offset"]).reshape(t["shape"])
                 entries.append(dict(t, array=arr))
+        if not self._full_coverage(entries):
+            # partial step (a rank's shards never landed): assembling
+            # would fill the holes with uninitialized memory
+            logger.error("step %d on storage is missing shards — refusing "
+                         "to assemble a partial checkpoint", step)
+            return None
         return self._assemble(entries)
 
     @staticmethod
@@ -347,6 +353,59 @@ class CheckpointEngine:
     def latest_step(self) -> int:
         return max(self._latest_step,
                    read_last_step(self.checkpoint_dir, self.storage))
+
+    def committed_steps(self, path: Optional[str] = None) -> list:
+        """Sorted steps on storage bearing the commit marker.
+
+        Loss-spike rollback needs to pick a step BEFORE the spike, not just
+        the tracker's latest — the latest commit can postdate spike onset.
+        The marker (written by `commit_checkpoint` only after EVERY shard's
+        done-file landed) is required: a non-empty done-dir alone can be a
+        partial set whose assembly would be silent garbage.
+        """
+        from ..common.constants import CheckpointConstant
+
+        path = path or self.checkpoint_dir
+        prefix = CheckpointConstant.CKPT_NAME_PREFIX
+        steps = []
+        for name in self.storage.listdir(path):
+            if not name.startswith(prefix):
+                continue
+            try:
+                step = int(name[len(prefix):])
+            except ValueError:
+                continue
+            marker = os.path.join(path, name,
+                                  CheckpointConstant.COMMIT_MARKER)
+            if self.storage.exists(marker):
+                steps.append(step)
+        return sorted(steps)
+
+    def demote_steps_after(self, step: int,
+                           path: Optional[str] = None) -> None:
+        """Point the tracker at `step` and delete NEWER step dirs.
+
+        Rollback durability: once a spike rollback resumes from `step`,
+        the post-spike commits are a poisoned lineage — if they survived,
+        any later crash (before the rolled-back run commits fresh) would
+        resume from them and silently undo the rollback.
+        """
+        from ..common.constants import CheckpointConstant
+
+        path = path or self.checkpoint_dir
+        for s in self.committed_steps(path):
+            if s > step:
+                logger.warning("rollback: discarding post-spike "
+                               "checkpoint step %d", s)
+                self.storage.safe_remove(step_dir(path, s))
+        self.storage.write(str(step), os.path.join(
+            path, CheckpointConstant.TRACKER_FILE))
+        self._latest_step = min(self._latest_step, step)
+        # the shm staging may still hold the newest (post-spike) state —
+        # a later plain load() would prefer it over the demoted tracker
+        header = self._shm_handler.load_header()
+        if header and header.get("step", 0) > step:
+            self._shm_handler.mark_empty()
 
     def close(self):
         try:

@@ -27,6 +27,9 @@ class NodeEnv:
     # Restart bookkeeping
     RESTART_COUNT = "DWT_RESTART_COUNT"
     PARAL_CONFIG_PATH = "DWT_PARAL_CONFIG_PATH"
+    # loss-spike rollback: resume from the newest committed ckpt whose
+    # step precedes this value (set one-shot by the agent on relaunch)
+    ROLLBACK_BEFORE_STEP = "DWT_ROLLBACK_BEFORE_STEP"
 
 
 class NodeType:
@@ -134,6 +137,9 @@ class CheckpointConstant:
     MODEL_STATES_NAME = "model_states"
     OPTIM_STATES_NAME = "optim_states"
     DONE_DIR = ".done"
+    # written inside a step dir when the tracker publishes it — the durable
+    # "all shards landed" witness (done-files alone can be a partial set)
+    COMMIT_MARKER = ".commit"
     SAVE_TIMEOUT = 600
 
 

@@ -24,6 +24,9 @@ class Context:
     seconds_interval_to_optimize: float = 300.0
     train_speed_record_num: int = 50
     hang_detection_seconds: float = 1800.0
+    # master diagnosis cadence (loss-spike / hang / straggler sweep);
+    # chaos drills and e2e tests override via DWT_CTX_DIAGNOSIS_INTERVAL
+    diagnosis_interval: float = 60.0
     rdzv_join_timeout: float = 600.0
     network_check: bool = False
     auto_tunning: bool = False

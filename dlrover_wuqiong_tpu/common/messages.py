@@ -175,6 +175,9 @@ class HeartBeat:
 @message
 class HeartbeatResponse:
     action: str = ""  # "", "restart", "stop"
+    # for action="restart" fired by a loss-spike rollback: resume from the
+    # newest committed checkpoint whose step PRECEDES this (-1 = latest)
+    rollback_before_step: int = -1
 
 
 @message
@@ -339,6 +342,10 @@ class DiagnosisReport:
 
 @message
 class DiagnosisAction:
-    action: str = ""  # "", "restart_worker", "relaunch_node"
+    action: str = ""  # "", "restart_worker", "relaunch_node", "rollback"
     reason: str = ""
     node_id: int = -1
+    # spike-onset step for "rollback" (ADVICE r4: the latest committed
+    # checkpoint can postdate spike onset — the restart must target the
+    # newest committed step BEFORE this); -1 = unknown/latest
+    step: int = -1

@@ -70,6 +70,9 @@ class Node:
         self.hang = False
         self.reported_status = ""
         self.restart_training = False
+        # set with restart_training by a loss-spike rollback: the restarted
+        # worker must resume from a committed ckpt step BEFORE this
+        self.rollback_before_step = -1
         self.paral_config_version = 0
 
     # ------------------------------------------------------------- transitions

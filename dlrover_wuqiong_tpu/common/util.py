@@ -2,6 +2,50 @@
 
 from __future__ import annotations
 
+from typing import Any
+
+
+def _first_sum(leaves):
+    import jax.numpy as jnp
+
+    total = jnp.float32(0.0)
+    for a in leaves:
+        total = total + jnp.float32(jnp.ravel(a)[0])
+    return total
+
+
+_sync_jit = None
+
+
+def sync_tree(tree: Any) -> float:
+    """Synchronize EVERY device-array leaf of `tree` with one host readback.
+
+    `jax.block_until_ready` is a NO-OP over the axon TPU tunnel
+    (CLAUDE.md), and reading back a single leaf only proves THAT leaf's
+    transfer/compute finished — the round-4 verdict flagged two advertised
+    metrics (`last_sync_s`, `restore_s`) as lower bounds for exactly this
+    reason.  The sum over per-leaf first elements depends on every leaf;
+    the single `float()` readback then waits for the whole tree.  The
+    reduction runs as ONE jitted dispatch (per-leaf eager ops would pay
+    the ~5-8ms tunnel dispatch cost hundreds of times and inflate the
+    metric the caller is measuring).  The first call per tree structure
+    compiles — callers timing a window should warm the helper on a
+    same-structure tree first (bench.py does).
+
+    Returns the (meaningless) sum so callers can assert it is finite if
+    they want an extra liveness check.
+    """
+    global _sync_jit
+    import jax
+    import numpy as np
+
+    leaves = [x for x in jax.tree.leaves(tree) if np.size(x) > 0]
+    if not leaves:
+        return 0.0
+    if _sync_jit is None:
+        _sync_jit = jax.jit(_first_sum)
+    return float(_sync_jit(leaves))
+
 
 def is_oom_error(exc: BaseException) -> bool:
     """True when `exc` is an accelerator out-of-memory failure.

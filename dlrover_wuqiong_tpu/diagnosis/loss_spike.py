@@ -61,7 +61,8 @@ class CheckLossSpikeOperator(InferenceOperator):
             if not math.isfinite(last):
                 out.append(Inference(
                     "loss_spike", node_id=node_id, is_conclusion=True,
-                    detail=f"non-finite loss {last} at step {last_step}"))
+                    detail=f"non-finite loss {last} at step {last_step}",
+                    step=int(last_step)))
                 continue
             hist = [x for _, _, x in series[:-1] if math.isfinite(x)]
             if len(hist) < self.min_points:
@@ -77,5 +78,6 @@ class CheckLossSpikeOperator(InferenceOperator):
                     "loss_spike", node_id=node_id, is_conclusion=True,
                     detail=(f"loss {last:.4g} at step {last_step} vs "
                             f"median {med:.4g} (mad {mad:.4g}) over "
-                            f"{len(hist)} points")))
+                            f"{len(hist)} points"),
+                    step=int(last_step)))
         return out

@@ -48,6 +48,7 @@ class Inference:
     node_id: int = -1
     detail: str = ""
     is_conclusion: bool = False
+    step: int = -1                 # onset step (loss_spike), -1 = n/a
 
 
 class DiagnosisDataManager:
@@ -375,8 +376,9 @@ _ACTION_FOR = {
     "straggler": "report",           # surfaced; operator policy decides
     "memory_over_limit": "relaunch_node",
     "memory_trend": "report",
-    # rollback = restart the worker; it auto-resumes from the last
-    # committed flash checkpoint — a pre-spike state (diagnosis/loss_spike)
+    # rollback = restart the worker; the action carries the spike-onset
+    # step so the resume targets the newest committed flash checkpoint
+    # PRECEDING the spike (the latest commit may postdate onset)
     "loss_spike": "rollback",
 }
 
@@ -433,7 +435,7 @@ class DiagnosisManager:
                 self._last_fired[key] = now
             actions.append(msg.DiagnosisAction(
                 action=action, node_id=c.node_id,
-                reason=f"{c.name}: {c.detail}"))
+                reason=f"{c.name}: {c.detail}", step=c.step))
         for a in actions:
             self._execute(a)
         with self._lock:
@@ -457,6 +459,11 @@ class DiagnosisManager:
                 for node in nodes:
                     if node is not None:
                         node.restart_training = True
+                        if action.action == "rollback" and action.step >= 0:
+                            # spike onset: the restarted worker must resume
+                            # from a ckpt committed BEFORE this step — the
+                            # latest commit can postdate onset (ADVICE r4)
+                            node.rollback_before_step = action.step
             elif action.action == "relaunch_node":
                 from ..common.constants import (
                     NodeEventType,

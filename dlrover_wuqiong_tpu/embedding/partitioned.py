@@ -59,10 +59,18 @@ class EmbeddingShardServer:
 
     def __init__(self, embedding: KvEmbedding, shard_id: int,
                  num_shards: int, host: str = "127.0.0.1", port: int = 0,
-                 advertise_host: Optional[str] = None):
+                 advertise_host: Optional[str] = None,
+                 client_idle_horizon: float = 600.0):
         """Bind `host` (use "0.0.0.0" to serve off-host) and advertise
         `advertise_host` (the address peers dial — required when binding a
-        wildcard, since "0.0.0.0:port" is not dialable)."""
+        wildcard, since "0.0.0.0:port" is not dialable).
+
+        `client_idle_horizon`: seconds a client may go quiet before its
+        dedup cache is evicted.  MUST strictly exceed the RPC client's
+        worst-case retry window (timeout x retries + backoff — ~181s at
+        the defaults) or a very late retry could double-apply emb_grads;
+        the default also clears the multi-minute tunnel stalls documented
+        in CLAUDE.md (ADVICE r4)."""
         self.embedding = embedding
         self.shard_id = shard_id
         self.num_shards = num_shards
@@ -83,7 +91,7 @@ class EmbeddingShardServer:
         # silently re-enable the double-apply bug this cache prevents.
         self._applied: "OrderedDict[str, Tuple[float, Dict[int, Dict]]]" = \
             OrderedDict()
-        self._client_idle_horizon = 300.0  # seconds, >> RPC retry window
+        self._client_idle_horizon = float(client_idle_horizon)
         self._server = RpcServer(self._handle, host=host, port=port)
         if advertise_host is None:
             if host in ("0.0.0.0", "::", ""):

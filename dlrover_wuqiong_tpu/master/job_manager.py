@@ -118,17 +118,25 @@ class JobManager:
     def collect_heartbeat(self, node_id: int,
                           timestamp: Optional[float] = None) -> str:
         """Returns an action for the node ("" | "restart" | "stop")."""
+        return self.collect_heartbeat_full(node_id, timestamp)[0]
+
+    def collect_heartbeat_full(self, node_id: int,
+                               timestamp: Optional[float] = None
+                               ) -> tuple:
+        """(action, rollback_before_step) — step is -1 unless a loss-spike
+        rollback pinned a pre-spike resume ceiling on the node."""
         with self._lock:
             node = self._nodes.get(node_id)
             if node is None:
-                return ""
+                return "", -1
             node.heartbeat_time = timestamp or time.time()
             if node.status in (NodeStatus.INITIAL, NodeStatus.PENDING):
                 node.update_status(NodeStatus.RUNNING)
             if node.restart_training:
                 node.restart_training = False
-                return "restart"
-            return ""
+                rb, node.rollback_before_step = node.rollback_before_step, -1
+                return "restart", rb
+            return "", -1
 
     def get_dead_nodes(self) -> List[Node]:
         """Nodes whose heartbeat timed out (parity `_get_dead_node_event`)."""

@@ -110,7 +110,8 @@ class JobMaster:
 
     def prepare(self):
         self._server.start()
-        self.diagnosis_manager.start(interval=60.0)
+        self.diagnosis_manager.start(
+            interval=get_context().diagnosis_interval)
         if self._exporter is not None:
             self._exporter.start()
         logger.info("master ready on port %s", self.port)

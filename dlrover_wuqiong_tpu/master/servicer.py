@@ -133,12 +133,13 @@ class MasterServicer:
             return msg.OkResponse()
 
         if isinstance(payload, msg.HeartBeat):
-            action = m.job_manager.collect_heartbeat(payload.node_id,
-                                                     payload.timestamp)
+            action, rb = m.job_manager.collect_heartbeat_full(
+                payload.node_id, payload.timestamp)
             if payload.global_step:
                 m.speed_monitor.collect_global_step(payload.global_step,
                                                     payload.timestamp)
-            return msg.HeartbeatResponse(action=action)
+            return msg.HeartbeatResponse(action=action,
+                                         rollback_before_step=rb)
 
         if isinstance(payload, msg.NodeMeta):
             node = m.job_manager.register_node(

@@ -47,6 +47,10 @@ try:
 except ImportError:  # pragma: no cover
     pltpu = None
 
+from ..common.log import get_logger
+
+logger = get_logger("flash_attention")
+
 NEG_INF = -1e30  # avoids inf-inf NaNs while dominating any real score
 
 
@@ -201,12 +205,19 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 def _fit_pack(bh: int) -> int:
     """Heads packed per grid step: largest of 8/4/2/1 dividing bh.
 
-    DWT_FA_PACK overrides the preference order's head (sweep hook)."""
+    DWT_FA_PACK overrides the preference order's head (sweep hook).  The
+    override is clamped to 8: kernel VMEM scratch scales linearly with
+    pack against the fixed 100MB vmem_limit, and an oversized value would
+    fail at Mosaic compile time with an opaque error (ADVICE r4)."""
     import os
 
     try:
         pref = int(os.getenv("DWT_FA_PACK", "8"))
     except ValueError:  # empty/garbage env value: fall back, don't abort
+        pref = 8
+    if pref > 8:
+        logger.warning("DWT_FA_PACK=%d exceeds the VMEM-safe maximum of 8 "
+                       "— clamping", pref)
         pref = 8
     for p in (pref, 8, 4, 2):
         if p >= 1 and bh % p == 0:

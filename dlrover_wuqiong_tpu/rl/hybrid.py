@@ -67,14 +67,16 @@ class HybridEngine:
         if self._decode_sh is None:
             self._decode_sh = self.decode_planner.param_shardings(
                 actor_params)
-        import jax.numpy as jnp
+        from ..common.util import sync_tree
 
         t0 = time.perf_counter()
         placed = jax.device_put(actor_params, self._decode_sh)
-        # host readback, not block_until_ready — the latter is a NO-OP
-        # over the axon TPU tunnel (CLAUDE.md hard-won rule), which would
-        # make the advertised sync-latency metric measure dispatch only
-        float(jnp.float32(jax.tree.leaves(placed)[0].reshape(-1)[0]))
+        # all-leaf readback, not block_until_ready (a NO-OP over the axon
+        # tunnel) and not a single-leaf probe (a lower bound — other
+        # leaves may still be in flight; r4 verdict weak #2).  The first
+        # call also compiles the sync reduction — steady-state
+        # last_sync_s is the second call onward.
+        sync_tree(placed)
         self.last_sync_s = time.perf_counter() - t0
         return placed
 

@@ -211,7 +211,17 @@ class Trainer:
         a = self.args
         start_step = 0
         if a.resume:
-            restored = self.ckpt.load_checkpoint(self.state)
+            from ..common.constants import NodeEnv
+
+            # one-shot rollback ceiling injected by the agent after a
+            # loss-spike diagnosis: resume from BEFORE the spike, not from
+            # the latest commit (which can postdate onset)
+            try:
+                rb = int(os.getenv(NodeEnv.ROLLBACK_BEFORE_STEP, "-1"))
+            except ValueError:  # empty/garbage env: resume normally,
+                rb = -1        # don't wedge the restart loop
+            restored = self.ckpt.load_checkpoint(
+                self.state, before_step=rb if rb >= 0 else None)
             if restored is not None:
                 self.state = restored
                 start_step = int(np.asarray(

@@ -356,3 +356,58 @@ class TestOrbaxInterop:
             ck.close()
         for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(loaded)):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestRollbackBeforeStep:
+    def test_load_before_step_picks_pre_spike_commit(self, tmp_path):
+        """ADVICE r4: rollback must restore the newest committed step that
+        PRECEDES the spike, not the tracker's latest (which may postdate
+        spike onset)."""
+        ckpt_dir = str(tmp_path / "rb")
+        ck = FlashCheckpointer(ckpt_dir, job_name="t-rb1", standalone=True)
+        for step in (5, 10, 15):
+            ck.save_checkpoint(step, {"w": jnp.full((4,), float(step))},
+                               storage_type=StorageType.DISK)
+            # each staged step must commit before the next save reuses the
+            # shm segment (flash ckpt keeps ONE staged step at a time)
+            assert ck.wait_latest_checkpoint(30)
+        assert ck.engine.committed_steps() == [5, 10, 15]
+        template = {"w": jnp.zeros((4,))}
+        # spike detected at step 12 -> newest committed step < 12 is 10
+        restored = ck.load_checkpoint(template, before_step=12)
+        np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                      np.full((4,), 10.0))
+        # rollback durability: the post-spike step 15 is a poisoned
+        # lineage — demoted so a later naive resume cannot pick it up
+        assert ck.engine.committed_steps() == [5, 10]
+        assert ck.last_step() == 10
+        # no committed step precedes 5 -> falls back to latest (now 10)
+        restored = ck.load_checkpoint(template, before_step=5)
+        np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                      np.full((4,), 10.0))
+        ck.close()
+
+    def test_partial_step_not_committed_and_not_assembled(self, tmp_path):
+        """A step dir with done-files but NO commit marker (crash before
+        every shard landed) must be invisible to rollback, and a
+        shard-incomplete step must refuse to assemble."""
+        import os
+        import shutil
+
+        ckpt_dir = str(tmp_path / "rbp")
+        ck = FlashCheckpointer(ckpt_dir, job_name="t-rb2", standalone=True)
+        for step in (5, 10):
+            ck.save_checkpoint(step, {"w": jnp.full((4,), float(step))},
+                               storage_type=StorageType.DISK)
+            assert ck.wait_latest_checkpoint(30)
+        # forge a partial step 8: copy step 5's dir, strip the marker
+        src, dst = (os.path.join(ckpt_dir, f"checkpoint-{s}")
+                    for s in (5, 8))
+        shutil.copytree(src, dst)
+        os.remove(os.path.join(dst, ".commit"))
+        assert ck.engine.committed_steps() == [5, 10]  # 8 invisible
+        restored = ck.load_checkpoint({"w": jnp.zeros((4,))},
+                                      before_step=9)
+        np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                      np.full((4,), 5.0))
+        ck.close()

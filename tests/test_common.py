@@ -185,3 +185,21 @@ class TestIpc:
         assert big.size >= 4096
         big.close()
         big.unlink()
+
+
+class TestSyncTree:
+    def test_sync_tree_touches_every_leaf(self):
+        import jax.numpy as jnp
+
+        from dlrover_wuqiong_tpu.common.util import sync_tree
+
+        tree = {"a": jnp.ones((4, 4)), "b": [jnp.arange(3),
+                jnp.zeros((0,))], "c": jnp.bool_(True)}
+        total = sync_tree(tree)
+        # 1.0 (a[0,0]) + 0.0 (arange[0]) + empty skipped + 1.0 (bool)
+        assert total == 2.0
+
+    def test_sync_tree_empty(self):
+        from dlrover_wuqiong_tpu.common.util import sync_tree
+
+        assert sync_tree({}) == 0.0

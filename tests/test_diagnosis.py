@@ -182,3 +182,21 @@ class TestLossSpike:
         self._feed(dm, [5.0, 100.0])  # too few points to judge
         actions = dm.diagnose_once()
         assert not any(a.action == "rollback" for a in actions), actions
+
+    def test_rollback_carries_spike_step_to_heartbeat(self):
+        """ADVICE r4: the rollback must target a PRE-spike checkpoint — the
+        spike-onset step flows detector -> action -> node -> heartbeat."""
+        jm = JobManager()
+        node = jm.register_node(NodeType.WORKER, 0)
+        node.update_status(NodeStatus.RUNNING)
+        dm = DiagnosisManager(hang_timeout=1e9, job_manager=jm)
+        _step(dm.data, 0, time.time())
+        self._feed(dm, [2.0 + 0.01 * (i % 5) for i in range(20)] + [9.5])
+        actions = dm.diagnose_once()
+        spike = [a for a in actions if a.action == "rollback"]
+        assert spike and spike[0].step == 20, spike  # onset = 21st sample
+        assert node.rollback_before_step == 20
+        action, rb = jm.collect_heartbeat_full(0)
+        assert action == "restart" and rb == 20
+        # one-shot: the ceiling clears after delivery
+        assert jm.collect_heartbeat_full(0) == ("", -1)
