@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 from typing import Optional
 
 import jax
@@ -548,6 +549,23 @@ def _use_pallas(sq, sk, d, block_q, block_k) -> bool:
             and _fit_block(sk, block_k) is not None)
 
 
+def _use_streamed(sq, sk) -> bool:
+    """Blockwise-scan fallback instead of the dense O(sq*sk) reference.
+
+    Only consulted when the Pallas kernels are unavailable (non-TPU
+    backend).  The dense fallback materializes full f32 score matrices —
+    fine for small test shapes, but it misrepresents the TPU program's
+    memory on big shapes: the 8B AOT fit proof (tests/test_scale_8b.py)
+    compiles on a virtual CPU mesh, where dense attention would dominate
+    `memory_analysis()` with buffers the Pallas path never allocates.
+    DWT_FA_STREAMED=1/0 forces the choice; the default switches at the
+    point where a per-head score matrix reaches 2048^2 (16MB f32)."""
+    env = os.getenv("DWT_FA_STREAMED")
+    if env is not None:
+        return env == "1"
+    return sq * sk >= 2048 * 2048
+
+
 def _kernel_head_dim(d: int) -> int:
     """Head dim as seen by the kernels.
 
@@ -588,6 +606,9 @@ def _fa_fwd_lse(q, k, v, causal, sm_scale, block_q, block_k):
                                     interpret=False)
         out = o[:, :, :d].reshape(b, h, sq, d)
         return (out, lse.reshape(b, h, sq)), (q, k, v, o, lse)
+    if _use_streamed(sq, sk):
+        out, lse = _streamed_with_lse(q, k, v, causal, scale, block_k)
+        return (out, lse), (q, k, v, out, lse)
     out, lse = _reference_with_lse(q, k, v, causal, scale)
     return (out, lse), (q, k, v, out, None)
 
@@ -608,6 +629,101 @@ def _reference_with_lse(q, k, v, causal, scale):
     return o, lse
 
 
+def _streamed_with_lse(q, k, v, causal, scale, block_k):
+    """Online-softmax forward as a `lax.scan` over key blocks.
+
+    Same math as the Pallas kernel, in plain jnp: peak temps are
+    O(h * sq * block_k) instead of the dense path's O(h * sq * sk) — the
+    memory-faithful any-backend stand-in for the kernel (used by the 8B
+    AOT fit proof on the virtual CPU mesh)."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bk = _fit_block(sk, min(block_k, 512)) or sk
+    nb = sk // bk
+    q32 = q.astype(jnp.float32)
+    kb = jnp.moveaxis(k.reshape(b, h, nb, bk, d), 2, 0)
+    vb = jnp.moveaxis(v.reshape(b, h, nb, bk, d), 2, 0)
+    rows = jnp.arange(sq) + (sk - sq)  # absolute key index each row sees
+
+    def body(carry, inp):
+        acc, m, l = carry
+        j, kblk, vblk = inp
+        s = jnp.einsum("bhqd,bhkd->bhqk", q32,
+                       kblk.astype(jnp.float32)) * scale
+        mask = None
+        if causal:
+            cols = j * bk + jnp.arange(bk)
+            mask = rows[:, None] >= cols[None, :]
+            s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        if mask is not None:
+            # a fully-masked row has m_new == NEG_INF and exp(s - m_new)
+            # == 1 for its masked entries — zero them so l stays 0 and
+            # the l>0 guard below yields out=0 / lse=-inf (matching the
+            # dense reference; sq > sk rows exercise this)
+            p = jnp.where(mask, p, 0.0)
+        l = l * alpha + p.sum(-1)
+        acc = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vblk.astype(jnp.float32))
+        return (acc, m_new, l), None
+
+    init = (jnp.zeros((b, h, sq, d), jnp.float32),
+            jnp.full((b, h, sq), NEG_INF, jnp.float32),
+            jnp.zeros((b, h, sq), jnp.float32))
+    (acc, m, l), _ = jax.lax.scan(body, init, (jnp.arange(nb), kb, vb))
+    l_safe = jnp.where(l > 0, l, 1.0)
+    out = (acc / l_safe[..., None]).astype(q.dtype)
+    lse = jnp.where(l > 0, m + jnp.log(l_safe), -jnp.inf)
+    return out, lse
+
+
+def _streamed_bwd(q, k, v, out, lse, g, causal, scale, block_q, glse):
+    """Flash-style recompute backward as one `lax.scan` over query blocks.
+
+    Each step re-derives p for its q block from the stored lse, emits the
+    block's dq, and accumulates dk/dv — peak temps O(h * block_q * sk)."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq = _fit_block(sq, min(block_q, 512)) or sq
+    nb = sq // bq
+    q32, k32, v32 = (t.astype(jnp.float32) for t in (q, k, v))
+    g32 = g.astype(jnp.float32)
+    delta = (g32 * out.astype(jnp.float32)).sum(-1)  # (b, h, sq)
+    if glse is not None:
+        delta = delta - glse
+    lse_safe = jnp.where(jnp.isfinite(lse), lse, 0.0)
+    qb = jnp.moveaxis(q32.reshape(b, h, nb, bq, d), 2, 0)
+    gb = jnp.moveaxis(g32.reshape(b, h, nb, bq, d), 2, 0)
+    lb = jnp.moveaxis(lse_safe.reshape(b, h, nb, bq), 2, 0)
+    db = jnp.moveaxis(delta.reshape(b, h, nb, bq), 2, 0)
+    cols = jnp.arange(sk)
+    off = sk - sq
+
+    def body(carry, inp):
+        dk, dv = carry
+        i, qblk, gblk, lseblk, dblk = inp
+        s = jnp.einsum("bhqd,bhkd->bhqk", qblk, k32) * scale
+        p = jnp.exp(s - lseblk[..., None])
+        if causal:
+            rows = i * bq + jnp.arange(bq) + off
+            p = jnp.where(rows[:, None] >= cols[None, :], p, 0.0)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", gblk, v32)
+        ds = p * (dp - dblk[..., None])
+        dqblk = jnp.einsum("bhqk,bhkd->bhqd", ds, k32) * scale
+        dk = dk + jnp.einsum("bhqk,bhqd->bhkd", ds, qblk) * scale
+        dv = dv + jnp.einsum("bhqk,bhqd->bhkd", p, gblk)
+        return (dk, dv), dqblk
+
+    init = (jnp.zeros((b, h, sk, d), jnp.float32),
+            jnp.zeros((b, h, sk, d), jnp.float32))
+    (dk, dv), dqb = jax.lax.scan(
+        body, init, (jnp.arange(nb), qb, gb, lb, db))
+    dq = jnp.moveaxis(dqb, 0, 2).reshape(b, h, sq, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
 def _fa_bwd_impl(causal, sm_scale, block_q, block_k, res, g, glse):
     """Shared backward; glse (b, h, sq) f32 or None folds the lse cotangent
     into delta (d lse / d s = p, so ds = p * (dp - delta + glse))."""
@@ -615,6 +731,12 @@ def _fa_bwd_impl(causal, sm_scale, block_q, block_k, res, g, glse):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     scale = _resolve_scale(sm_scale, d)
+    if lse is not None and not _use_pallas(sq, sk, d, block_q, block_k):
+        # streamed forward ran (lse present, kernels unavailable): its
+        # recompute backward — NOT the dense path, which would undo the
+        # memory bound the streamed path exists for
+        return _streamed_bwd(q, k, v, out, lse, g, causal, scale,
+                             block_q, glse)
     if lse is not None:  # pallas forward ran: pallas backward
         bq = _fit_block(sq, block_q)
         bk = _fit_block(sk, block_k)

@@ -99,3 +99,21 @@ def stable_bf16(inner: optax.GradientTransformation,
         return out, StableBF16State(inner=inner_s, comp=comp)
 
     return optax.GradientTransformation(init_fn, update_fn)
+
+
+def reset_compensation(state: StableBF16State, params: Any,
+                       master: bool) -> StableBF16State:
+    """Re-anchor the comp state after params were rewritten EXTERNALLY.
+
+    DiLoCo's outer sync overwrites the inner params with the synced global
+    tree; the stale f32 master (or Kahan term) would then silently UNDO
+    the sync on the next update (master mode derives p from the master).
+    Call this with the post-sync params: master := f32(new params);
+    Kahan error := 0."""
+    if master:
+        comp = jax.tree.map(
+            lambda p: p.astype(jnp.float32) if _is_float(p) else p, params)
+    else:
+        comp = jax.tree.map(
+            lambda c: jnp.zeros_like(c) if _is_float(c) else c, state.comp)
+    return StableBF16State(inner=state.inner, comp=comp)

@@ -81,6 +81,9 @@ def make_diloco_train_step(
     planner: ShardingPlanner,
     cfg: LocalSGDConfig,
     accum_steps: int = 1,
+    reset_opt_on_sync: Optional[Callable[[Any, Any], Any]] = None,
+    opt_host_shardings: Any = None,
+    opt_device_shardings: Any = None,
 ):
     """Returns jit'd `step(DiLoCoState, batch) -> (DiLoCoState, metrics)`.
 
@@ -91,6 +94,15 @@ def make_diloco_train_step(
     accumulate INSIDE the inner step — the accumulation is entirely local
     to each replica group, so it composes with the two-level scheme (the
     round-3 local_sgd x grad_accum rejection, closed).
+
+    `reset_opt_on_sync(opt_state, new_params) -> opt_state` re-anchors
+    optimizer state whose contents DERIVE the params (stable_bf16's f32
+    master / Kahan term) after the outer sync rewrites them — without it
+    the stale master would undo the sync on the next inner update.
+    `opt_host_shardings`/`opt_device_shardings` (both or neither): the
+    STACKED inner optimizer state lives in pinned_host between steps
+    (optimizer_offload x local_sgd) and hops to device for the update —
+    same contract as trainer/train_step.py.
     """
     if _shard_map is None:  # pragma: no cover
         raise RuntimeError("local_sgd needs jax.shard_map")
@@ -142,6 +154,10 @@ def make_diloco_train_step(
                 w, step_dir)
             # every group restarts the next round from the synced params
             p = jax.tree.map(lambda wl: wl.astype(wl.dtype), w)
+            if reset_opt_on_sync is not None:
+                # params-deriving opt state (stable_bf16 master/Kahan)
+                # must re-anchor on the synced tree or it undoes the sync
+                o = reset_opt_on_sync(o, p)
             return p, o, w, mom
 
         def _nosync(args):
@@ -166,24 +182,36 @@ def make_diloco_train_step(
         axis_names={"dp"}, check_vma=False)
 
     def train_step(state: DiLoCoState, batch):
+        inner_o = state.inner_opt_state
+        if opt_host_shardings is not None:
+            inner_o = jax.device_put(inner_o, opt_device_shardings)
         inner_p, inner_o, outer_p, outer_m, loss = body(
-            state.step, state.inner_params, state.inner_opt_state,
+            state.step, state.inner_params, inner_o,
             state.outer_params, state.outer_momentum, batch)
+        if opt_host_shardings is not None:
+            inner_o = jax.device_put(inner_o, opt_host_shardings)
         new_state = DiLoCoState(state.step + 1, inner_p, inner_o, outer_p,
                                 outer_m)
         return new_state, {"loss": loss}
 
-    return jax.jit(train_step, donate_argnums=(0,))
+    # offloaded opt states: donation would alias a pinned_host input onto
+    # a device output (trainer/train_step.py's documented exception)
+    donate = (0,) if opt_host_shardings is None else ()
+    return jax.jit(train_step, donate_argnums=donate)
 
 
 def init_diloco_state(params: Any, inner_optimizer:
                       optax.GradientTransformation, mesh: Mesh,
                       planner: ShardingPlanner,
-                      cfg: LocalSGDConfig) -> DiLoCoState:
+                      cfg: LocalSGDConfig,
+                      offload_opt: bool = False) -> DiLoCoState:
     """Build + place the two-level state on the mesh.
 
     inner params/opt leaves gain a leading replica axis of size dp sharded
     P("dp", ...); outer params keep the planner's fsdp/tp specs.
+    `offload_opt` places the stacked inner optimizer arrays in pinned_host
+    (the optimizer_offload x local_sgd composition); the outer trees stay
+    on device — they are touched every sync and are 1/3 the bytes.
     """
     dp = mesh.shape["dp"]
     param_specs = planner.param_specs(params)
@@ -200,9 +228,13 @@ def init_diloco_state(params: Any, inner_optimizer:
 
     def _stack_opt(x):
         x = jnp.asarray(x)
-        return jax.device_put(
-            jnp.broadcast_to(x[None], (dp,) + x.shape),
-            NamedSharding(mesh, P(*(("dp",) + (None,) * x.ndim))))
+        sh = NamedSharding(mesh, P(*(("dp",) + (None,) * x.ndim)))
+        placed = jax.device_put(
+            jnp.broadcast_to(x[None], (dp,) + x.shape), sh)
+        if offload_opt and x.ndim > 0:  # scalars (counts) stay on device
+            placed = jax.device_put(placed, NamedSharding(
+                mesh, sh.spec, memory_kind="pinned_host"))
+        return placed
 
     inner_opt = jax.tree.map(_stack_opt, opt_state)
     outer_params = planner.shard_params(params)

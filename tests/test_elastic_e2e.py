@@ -126,12 +126,16 @@ data = np.random.default_rng(0).integers(0, cfg.vocab_size, (8, 33))
 batch = res.place_batch({"input_ids": jnp.asarray(data[:, :-1]),
                          "labels": jnp.asarray(data[:, 1:])})
 
-marker = os.path.join(marker_dir, f"start_r{restart}_p{pid}.json")
+marker = os.path.join(
+    marker_dir,
+    f"start_r{restart}_p{pid}_n{os.getenv('DWT_NODE_ID', 'x')}.json")
 with open(marker, "w") as f:
     json.dump({"start": start, "nprocs": nprocs,
-               "devices": len(jax.devices())}, f)
+               "devices": len(jax.devices()),
+               "node": int(os.getenv("DWT_NODE_ID", "-1")),
+               "restart": restart, "ospid": os.getpid()}, f)
 
-TOTAL = 8
+TOTAL = 30 if mode == "slice" else 8
 loss_log = os.path.join(marker_dir, f"losses_r{restart}_p{pid}.jsonl")
 for _ in range(start, TOTAL):
     state, m = res.train_step(state, batch)
@@ -141,6 +145,8 @@ for _ in range(start, TOTAL):
     ck.save_checkpoint(step, state, storage_type=StorageType.DISK)
     ck.wait_latest_checkpoint(60)
     ctx.report_step(step, force=True)
+    if mode == "slice":
+        time.sleep(0.2)  # widen the externally-injected kill window
     if mode == "crash" and restart == 0 and pid == 0 and step == 3:
         os._exit(17)  # injected fault AFTER step-3 commit
 
@@ -270,9 +276,9 @@ def test_jax_world_scale_up(tmp_path):
         # wait until node 0 trains alone, then add node 1
         deadline = _t.time() + 180
         while _t.time() < deadline and \
-                not (markers / "start_r0_p0.json").exists():
+                not list(markers.glob("start_r0_p0_*.json")):
             _t.sleep(0.5)
-        assert (markers / "start_r0_p0.json").exists()
+        assert list(markers.glob("start_r0_p0_*.json"))
         # wait for a COMMITTED checkpoint, not a fixed sleep: under CI
         # load the solo worker can take >4s to commit its first steps,
         # and the scale-up restart would then legitimately start from 0
@@ -300,3 +306,77 @@ def test_jax_world_scale_up(tmp_path):
         for a in agents:
             if a.poll() is None:
                 a.kill()
+
+
+def test_jax_world_slice_loss(tmp_path):
+    """Multi-slice failure domain (SURVEY §2.5 DCN row; reference node
+    groups dist_job_manager.py:88): a whole node group — agent AND its
+    worker, i.e. "slice 0", which hosts the jax.distributed coordinator —
+    is SIGKILLed mid-training.  The survivor's worker dies on the broken
+    world, a replacement node joins, the master re-forms the world with
+    {survivor, replacement}, and training resumes from the committed step
+    through to completion."""
+    import signal
+    import time as _t
+
+    script = tmp_path / "worker.py"
+    script.write_text(JAX_WORKER)
+    ckpt_dir = tmp_path / "ckpt"
+    markers = tmp_path / "markers"
+    markers.mkdir()
+    env = _base_env(tmp_path, "jx3")
+    port = _free_port()
+    master = _spawn_master(port, 2, 3, env)
+    agents = []
+    try:
+        _t.sleep(2.0)
+        a0 = _spawn_agent(0, script, [ckpt_dir, markers, "slice"],
+                          port, env)
+        a1 = _spawn_agent(1, script, [ckpt_dir, markers, "slice"],
+                          port, env)
+        agents = [a0, a1]
+        # wait until both slices train and a step committed
+        deadline = _t.time() + 180
+        tracker = ckpt_dir / "latest_checkpointed_iteration.txt"
+        node0_marker = None
+        while _t.time() < deadline:
+            r0 = [json.loads(p.read_text())
+                  for p in markers.glob("start_r0_p*.json")]
+            if len(r0) == 2 and tracker.exists():
+                node0_marker = next(m for m in r0 if m["node"] == 0)
+                break
+            _t.sleep(0.5)
+        assert node0_marker is not None, "slices never started training"
+        # kill slice 0 whole: the agent's process group AND its worker
+        # (the worker runs in its own session — start_new_session=True)
+        os.kill(a0.pid, signal.SIGKILL)
+        try:
+            os.killpg(node0_marker["ospid"], signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            os.kill(node0_marker["ospid"], signal.SIGKILL)
+        # replacement slice joins
+        a2 = _spawn_agent(2, script, [ckpt_dir, markers, "slice"],
+                          port, env)
+        agents.append(a2)
+        for a in (a1, a2):
+            out, _ = a.communicate(timeout=420)
+            assert a.returncode == 0, out[-4000:]
+        assert (markers / "done.txt").read_text() == "30"
+        # the re-formed 2-node world includes the REPLACEMENT node and
+        # resumed from committed state, not zero.  Post-kill markers:
+        # the survivor's restarts (restart > 0) and the replacement's
+        # first run (node 2, restart 0).
+        worlds = [json.loads(p.read_text())
+                  for p in markers.glob("start_r*_p*_n*.json")]
+        post = [w for w in worlds if w["restart"] > 0 or w["node"] == 2]
+        assert any(w["node"] == 2 and w["nprocs"] == 2 for w in post), \
+            worlds
+        assert all(w["start"] > 0 for w in post), post
+    finally:
+        master.kill()
+        for a in agents:
+            if a.poll() is None:
+                try:
+                    a.kill()
+                except ProcessLookupError:
+                    pass

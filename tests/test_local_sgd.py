@@ -184,3 +184,90 @@ class TestDiLoCoGradAccum:
         for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
             np.testing.assert_allclose(a, b, atol=1e-6)
         assert np.isfinite(float(m["loss"]))
+
+
+class TestDiLoCoStableBF16:
+    """local_sgd x stable_bf16 (round-4 rejection, closed): bf16 inner
+    params with Kahan/master precision, the outer sync re-anchoring the
+    comp state (optimizers/bf16_stable.py reset_compensation)."""
+
+    def _run(self, strategy, steps=8, lr=3e-3):
+        cfg = dataclasses.replace(GPTConfig.nano(), dtype=jnp.float32,
+                                  use_flash_attention=False, remat=False)
+        res = auto_accelerate(GPT(cfg), optimizer=optax.adam(lr),
+                              strategy=strategy, devices=jax.devices(),
+                              rng=jax.random.PRNGKey(5))
+        data = jax.random.randint(jax.random.PRNGKey(1), (8, 33), 0,
+                                  cfg.vocab_size)
+        batch = res.place_batch({"input_ids": data[:, :-1],
+                                 "labels": data[:, 1:]})
+        state, losses = res.state, []
+        for _ in range(steps):
+            state, m = res.train_step(state, batch)
+            losses.append(float(m["loss"]))
+        return state, losses
+
+    BASE = [("local_sgd", {"sync_every": 4, "outer_lr": 0.7}),
+            ("data_parallel", {"size": 2}), ("fsdp", {})]
+
+    @pytest.mark.parametrize("master", [False, True])
+    def test_trajectory_matches_f32(self, master):
+        s32, l32 = self._run(self.BASE)
+        sb, lb = self._run(self.BASE + [("stable_bf16",
+                                         {"master": master})])
+        # inner params became bf16
+        assert all(l.dtype == jnp.bfloat16
+                   for l in jax.tree.leaves(sb.inner_params))
+        # loss trajectory tracks f32 within bf16 tolerance, incl. ACROSS
+        # the sync step at 4 (comp-state re-anchor correctness)
+        np.testing.assert_allclose(lb, l32, rtol=0.05)
+
+    def test_sync_still_aligns_groups_bf16(self):
+        sb, _ = self._run(self.BASE + [("stable_bf16", {"master": True})])
+        g0 = jax.tree.map(lambda x: np.asarray(x[0], np.float32),
+                          sb.inner_params)
+        g1 = jax.tree.map(lambda x: np.asarray(x[1], np.float32),
+                          sb.inner_params)
+        for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+            np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+class TestDiLoCoOptimizerOffload:
+    """local_sgd x optimizer_offload (round-4 rejection, closed): stacked
+    inner moments live in pinned_host between steps."""
+
+    def _setup(self, offload):
+        cfg = dataclasses.replace(GPTConfig.nano(), dtype=jnp.float32,
+                                  use_flash_attention=False, remat=False)
+        strat = [("local_sgd", {"sync_every": 2, "outer_lr": 0.7}),
+                 ("data_parallel", {"size": 2}), ("fsdp", {})]
+        if offload:
+            strat.append(("optimizer_offload", {}))
+        res = auto_accelerate(GPT(cfg), optimizer=optax.adam(1e-2),
+                              strategy=strat, devices=jax.devices(),
+                              rng=jax.random.PRNGKey(7))
+        data = jax.random.randint(jax.random.PRNGKey(2), (8, 33), 0,
+                                  cfg.vocab_size)
+        batch = res.place_batch({"input_ids": data[:, :-1],
+                                 "labels": data[:, 1:]})
+        return res, batch
+
+    def test_moments_in_pinned_host_and_trajectory_identical(self):
+        res_d, batch = self._setup(offload=False)
+        res_h, _ = self._setup(offload=True)
+        # param-shaped moments stack to ndim >= 2; the stacked count
+        # scalar is (dp,) and legitimately stays on device
+        kinds = {l.sharding.memory_kind
+                 for l in jax.tree.leaves(res_h.state.inner_opt_state)
+                 if l.ndim > 1}
+        assert kinds == {"pinned_host"}, kinds
+        sd, sh = res_d.state, res_h.state
+        for _ in range(5):  # crosses the sync at step 2 and 4
+            sd, md = res_d.train_step(sd, batch)
+            sh, mh = res_h.train_step(sh, batch)
+            np.testing.assert_allclose(float(md["loss"]),
+                                       float(mh["loss"]), rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(sd.inner_params),
+                        jax.tree.leaves(sh.inner_params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6)
