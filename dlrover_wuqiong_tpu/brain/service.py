@@ -19,6 +19,7 @@ from .plugins import (
     BrainOptimizer,
     JsonFileDataStore,
     MemoryDataStore,
+    SqliteDataStore,
 )
 
 logger = get_logger("brain")
@@ -29,8 +30,17 @@ class BrainService:
 
     def __init__(self, port: int = 0, snapshot_path: Optional[str] = None,
                  store: Optional[MemoryDataStore] = None, **optimizer_kw):
-        self.store = store or (JsonFileDataStore(snapshot_path)
-                               if snapshot_path else MemoryDataStore())
+        if store is None:
+            if snapshot_path and snapshot_path.endswith(
+                    (".db", ".sqlite", ".sqlite3")):
+                # per-row-durable SQL store (reference MySQL datastore
+                # role); .json paths keep the snapshot store
+                store = SqliteDataStore(snapshot_path)
+            elif snapshot_path:
+                store = JsonFileDataStore(snapshot_path)
+            else:
+                store = MemoryDataStore()
+        self.store = store
         self.optimizer = BrainOptimizer(self.store, **optimizer_kw)
         self._server = RpcServer(self._handle, port=port)
 
@@ -50,6 +60,12 @@ class BrainService:
         # server first: no handler may mutate the store mid-flush
         self._server.stop()
         self.store.flush()
+        close = getattr(self.store, "close", None)
+        if close is not None:
+            # SqliteDataStore: checkpoint the WAL and release the
+            # connection (leaked -wal/-shm journals otherwise outlive
+            # every start/stop cycle)
+            close()
 
     # ------------------------------------------------------------- handlers
 

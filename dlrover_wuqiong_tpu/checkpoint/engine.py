@@ -464,7 +464,11 @@ def restore_pytree(template: Any, flat: Dict[str, np.ndarray]) -> Any:
         dtype = getattr(leaf, "dtype", None)
         if dtype is not None and value.dtype != dtype:
             if (sharding is not None
+                    and getattr(sharding, "memory_kind", None)
+                    in (None, "device")
                     and value.dtype.itemsize < np.dtype(dtype).itemsize):
+                # (pinned_host targets upcast on the HOST instead — an
+                # astype on a host-kind array would need host compute)
                 # NARROWER on the wire than in the template (bf16 wire
                 # staging): ship the stored bytes and upcast ON DEVICE —
                 # an eager host astype would double the H2D bytes, the

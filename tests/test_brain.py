@@ -173,3 +173,68 @@ class TestBrainPlugins:
         assert resp.memory_mb > 0
         c.close()
         svc.stop()
+
+
+class TestSqliteDataStore:
+    """SQL-durable datastore (reference MySQL datastore role, mysql.go):
+    every append is a durable row; restart replays the table."""
+
+    def test_append_survives_restart(self, tmp_path):
+        from dlrover_wuqiong_tpu.brain.plugins import SqliteDataStore
+
+        path = str(tmp_path / "brain.db")
+        ds = SqliteDataStore(path)
+        for i in range(5):
+            ds.append("j1", "worker", {"cpu": float(i), "memory_mb": 100})
+        ds.append("j2", "ps", {"cpu": 2.0, "memory_mb": 200})
+        ds.close()
+        # fresh process view: replay from the table, no flush() needed
+        ds2 = SqliteDataStore(path)
+        assert len(ds2.samples("j1", "worker")) == 5
+        assert ds2.samples("j2", "ps")[0]["memory_mb"] == 200
+        assert sorted(ds2.jobs()) == ["j1", "j2"]
+        ds2.close()
+
+    def test_table_bounded_by_max_samples(self, tmp_path):
+        from dlrover_wuqiong_tpu.brain.plugins import SqliteDataStore
+
+        path = str(tmp_path / "brain.db")
+        ds = SqliteDataStore(path, max_samples=10)
+        for i in range(25):
+            ds.append("j", "worker", {"cpu": float(i), "memory_mb": 1})
+        ds.close()
+        ds2 = SqliteDataStore(path, max_samples=10)
+        got = ds2.samples("j", "worker")
+        assert len(got) <= 10
+        assert got[-1]["cpu"] == 24.0  # newest retained
+        ds2.close()
+
+    def test_service_selects_sqlite_by_extension(self, tmp_path):
+        from dlrover_wuqiong_tpu.brain.plugins import SqliteDataStore
+        from dlrover_wuqiong_tpu.brain.service import BrainService
+
+        svc = BrainService(snapshot_path=str(tmp_path / "b.db"))
+        assert isinstance(svc.store, SqliteDataStore)
+
+    def test_replay_drops_schema_invalid_rows(self, tmp_path):
+        """Rows that parse as JSON but are not valid samples must be
+        dropped at replay, not left to crash optimize()."""
+        import sqlite3
+
+        from dlrover_wuqiong_tpu.brain.plugins import SqliteDataStore
+
+        path = str(tmp_path / "brain.db")
+        ds = SqliteDataStore(path)
+        ds.append("j", "worker", {"cpu": 1.0, "memory_mb": 10})
+        ds.close()
+        db = sqlite3.connect(path)
+        db.execute("INSERT INTO samples (job, node_type, sample)"
+                   " VALUES ('j', 'worker', '\"garbage\"')")
+        db.execute("INSERT INTO samples (job, node_type, sample)"
+                   " VALUES ('j', 'worker', '{\"foo\": 1}')")
+        db.commit()
+        db.close()
+        ds2 = SqliteDataStore(path)
+        got = ds2.samples("j", "worker")
+        assert len(got) == 1 and got[0]["cpu"] == 1.0, got
+        ds2.close()

@@ -152,3 +152,46 @@ class TestAutoPlanPins:
 
         p = auto_plan(64, int(70.6e9), hbm_per_device=95 << 30)
         assert (p.fsdp, p.tp) == (8, 8), p
+
+
+class TestAutoPlanGridInvariants:
+    """Beyond the 4 pinned north-star shapes: a realistic (params,
+    devices, HBM) grid where every plan must satisfy the planner's own
+    contract — axes multiply to the device count, and the optimizer
+    state fits the combined HBM of the state-sharding axes."""
+
+    GRID = [
+        (1.5e9, 8, 16), (1.5e9, 8, 95), (8.03e9, 8, 95),
+        (8.03e9, 32, 16), (8.03e9, 32, 95), (13e9, 16, 95),
+        (34e9, 64, 95), (70.6e9, 256, 95), (180e9, 256, 95),
+        (405e9, 512, 95),
+    ]
+
+    @pytest.mark.parametrize("params,devices,hbm_gib", GRID)
+    def test_plan_fits_and_multiplies(self, params, devices, hbm_gib):
+        import math
+
+        from dlrover_wuqiong_tpu.parallel.mesh import auto_plan
+
+        plan = auto_plan(devices, int(params),
+                         hbm_per_device=hbm_gib << 30)
+        sizes = [plan.dp, plan.pp, plan.fsdp, plan.ep, plan.sp, plan.tp]
+        assert math.prod(sizes) == devices, (plan, devices)
+        # the planner's own fit rule: state (14 B/param incl. bf16
+        # params + f32 master+moments) sharded over tp*fsdp must fit
+        # 70% of per-device HBM
+        state_bytes = params * 14
+        min_shards = max(1, math.ceil(
+            state_bytes / ((hbm_gib << 30) * 0.7)))
+        assert plan.tp * plan.fsdp >= min(min_shards, devices), (
+            plan, min_shards)
+
+    def test_sp_only_for_long_sequences(self):
+        from dlrover_wuqiong_tpu.parallel.mesh import auto_plan
+
+        short = auto_plan(32, int(8e9), hbm_per_device=95 << 30,
+                          seq_len=8192)
+        assert short.sp == 1, short
+        long = auto_plan(32, int(8e9), hbm_per_device=95 << 30,
+                         seq_len=131072)
+        assert long.sp > 1, long
