@@ -541,3 +541,29 @@ class TestStreamedAttention:
         np.testing.assert_allclose(dq, rq, atol=3e-4)
         np.testing.assert_allclose(dk, rk, atol=3e-4)
         np.testing.assert_allclose(dv, rv, atol=3e-4)
+
+
+class TestMultiSliceStrategy:
+    """Resolve-time contract of the multi_slice (DCN) strategy."""
+
+    def test_plan_shape(self):
+        from dlrover_wuqiong_tpu.auto.accelerate import resolve_strategy
+
+        ctx = resolve_strategy(
+            [("multi_slice", {"slices": 2, "tp": 2})], 8)
+        p = ctx.plan
+        assert (p.dp, p.fsdp, p.tp) == (2, 2, 2), p
+
+    def test_uneven_slices_rejected(self):
+        from dlrover_wuqiong_tpu.auto.accelerate import resolve_strategy
+
+        with pytest.raises(ValueError, match="devices/slice"):
+            resolve_strategy(
+                [("multi_slice", {"slices": 3})], 8)
+
+    def test_tp_must_divide_slice(self):
+        from dlrover_wuqiong_tpu.auto.accelerate import resolve_strategy
+
+        with pytest.raises(ValueError, match="divide the"):
+            resolve_strategy(
+                [("multi_slice", {"slices": 2, "tp": 3})], 8)
