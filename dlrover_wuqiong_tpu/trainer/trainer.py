@@ -46,6 +46,18 @@ class TrainingArgs:
     max_eval_batches: int = 32
     seed: int = 0
     resume: bool = True                      # auto-resume from output_dir
+    # "bf16" halves checkpoint bytes end to end (D2H staging, disk,
+    # restore H2D) — lossy for f32 state (checkpointer docstring); for
+    # restore-latency-critical deployments over slow host links
+    ckpt_wire_dtype: Optional[str] = None
+
+    def __post_init__(self):
+        if self.ckpt_wire_dtype not in (None, "bf16"):
+            # fail BEFORE Trainer runs param init + compile (CLAUDE.md:
+            # bad knobs error at construction time, not minutes later)
+            raise ValueError(
+                f"unsupported ckpt_wire_dtype {self.ckpt_wire_dtype!r}; "
+                f"use 'bf16' or None")
     profile_trace_dir: str = ""              # jax.profiler window target
     profile_start_step: int = -1
     profile_end_step: int = -1
@@ -99,7 +111,8 @@ class Trainer:
 
         self.ckpt = FlashCheckpointer(
             os.path.join(args.output_dir, "checkpoints"),
-            job_name=os.getenv("DWT_JOB_NAME", "dwt"))
+            job_name=os.getenv("DWT_JOB_NAME", "dwt"),
+            wire_dtype=args.ckpt_wire_dtype)
 
         from ..utils.profiler import StepProfiler
 

@@ -241,3 +241,35 @@ class TestTrainerDepth:
         Trainer(_model(), args, _data, eval_data=eval_data).train()
         # 8 steps / eval every 4 = 2 eval passes x 2 batches each
         assert len(eval_calls) == 4
+
+
+class TestWireDtypeTrainer:
+    def test_bf16_wire_train_save_resume(self, tmp_path):
+        """ckpt_wire_dtype="bf16" plumbs through to the checkpointer:
+        half-width shards on disk, resume still lands on the committed
+        step (values bf16-quantized — the documented contract)."""
+        import json as _json
+
+        args = TrainingArgs(
+            output_dir=str(tmp_path), max_steps=4, seq_len=32,
+            global_batch_size=8, warmup_steps=1, save_steps=2,
+            logging_steps=0, strategy=[("fsdp", {})],
+            ckpt_wire_dtype="bf16")
+        tr1 = Trainer(_model(), args, _data)
+        tr1.train()
+        tr1.ckpt.close()
+        AsyncCheckpointSaver.reset()
+        # f32 params were staged as bf16 on disk
+        sdir = tmp_path / "checkpoints" / "checkpoint-4"
+        metas = [t for mf in sdir.glob("meta_rank*.json")
+                 for t in _json.loads(mf.read_text())["tensors"]]
+        kinds = {t["dtype"] for t in metas
+                 if "wte" in t["name"] or "kernel" in t["name"]}
+        assert kinds == {"bfloat16"}, kinds
+
+        args2 = dataclasses.replace(args, max_steps=6)
+        tr2 = Trainer(_model(), args2, _data)
+        out = tr2.train()
+        assert out["final_step"] == 6
+        assert int(np.asarray(jax.tree.leaves(tr2.state.step)[0])) == 6
+        tr2.ckpt.close()
