@@ -100,6 +100,8 @@ class ElasticAgent:
         self._last_restart_ts = 0.0
         self._replica_server = None
         self._replica_manager = None
+        self._warm_pool = None
+        self._warm_generation = 0  # invalidates stale warm threads
         # last rendezvous round this agent ran in, PER rendezvous name
         # (network-check and elastic-training managers count independently):
         # a re-join after failure must wait for a NEWER round — accepting
@@ -230,6 +232,11 @@ class ElasticAgent:
             NodeEnv.LOCAL_DEVICE_COUNT: str(outcome.local_world_size),
             NodeEnv.RESTART_COUNT: str(self._restart_count),
         })
+        # one compile-cache dir across worker generations and warm
+        # children: the restarted worker must read what the pool wrote
+        from ..auto.compile_cache import default_cache_dir
+
+        env.setdefault(NodeEnv.COMPILE_CACHE_DIR, default_cache_dir())
         if self._rollback_before >= 0:
             # one-shot: the relaunched worker resumes from the newest
             # committed ckpt BEFORE the spike step, then the ceiling clears
@@ -383,6 +390,7 @@ class ElasticAgent:
             except Exception:  # noqa: BLE001 — replication is best-effort
                 logger.exception("checkpoint replication setup failed")
             self._worker = self._launch_worker(outcome)
+            self._kick_warm_pool(outcome)
             exit_code = self._monitor_worker()
             if exit_code == 0:
                 logger.info("worker succeeded")
@@ -426,6 +434,56 @@ class ElasticAgent:
             self._stop_worker()
         return 1
 
+    def _kick_warm_pool(self, outcome: RendezvousOutcome,
+                        spec_wait_s: float = 120.0):
+        """Speculatively compile the post-failure meshes while the world
+        is healthy (auto/warm_pool.py).
+
+        The worker publishes its compile spec (model + strategy + batch)
+        once its own auto_accelerate runs; a daemon thread here waits for
+        a spec matching THIS world, then launches warm children for the
+        degraded worlds (N−1 nodes).  The agent owns the lifecycle: it
+        survives worker death, so warming keeps running right through the
+        window where it matters.  DWT_WARM_POOL=0 disables.
+        """
+        if os.getenv("DWT_WARM_POOL", "1") == "0":
+            return
+        if outcome.num_processes <= 1:
+            return  # no degraded world below a single node
+        self._warm_generation += 1
+        generation = self._warm_generation
+        world_devices = outcome.num_processes * outcome.local_world_size
+
+        def _wait_and_warm():
+            from ..auto.compile_cache import default_cache_dir
+            from ..auto.warm_pool import WarmPool, load_current_spec
+
+            cache_dir = os.getenv(NodeEnv.COMPILE_CACHE_DIR,
+                                  default_cache_dir())
+            deadline = time.time() + spec_wait_s
+            while time.time() < deadline and not self._stopped.is_set() \
+                    and generation == self._warm_generation:
+                spec = load_current_spec(cache_dir)
+                # only a spec from THIS world: a stale file from the
+                # previous (larger) world would warm the wrong meshes
+                if spec is not None and \
+                        spec.n_devices == world_devices:
+                    if self._warm_pool is None:
+                        self._warm_pool = WarmPool(cache_dir)
+                    procs = self._warm_pool.warm_degraded(
+                        spec, num_nodes=outcome.num_processes,
+                        devices_per_node=outcome.local_world_size)
+                    if procs:
+                        logger.info(
+                            "warm pool: %d degraded-mesh compiles "
+                            "launched for world of %d", len(procs),
+                            world_devices)
+                    return
+                time.sleep(2.0)
+
+        threading.Thread(target=_wait_and_warm, daemon=True,
+                         name="dwt-warm-pool").start()
+
     def _monitor_worker(self) -> Optional[int]:
         """Wait for worker exit or membership change.
 
@@ -457,6 +515,10 @@ class ElasticAgent:
 
     def stop(self):
         self._stopped.set()
+        self._warm_generation += 1
+        if self._warm_pool is not None:
+            self._warm_pool.stop()
+            self._warm_pool = None
         self._stop_worker()
         tuner = getattr(self, "_config_tuner", None)
         if tuner is not None:

@@ -29,6 +29,11 @@ from ..parallel.mesh import (
     build_mesh,
     detect_hbm_per_device,
 )
+from .compile_cache import (
+    enable_persistent_cache,
+    note_train_step_served,
+    train_step_cache_key,
+)
 from ..parallel.sharding import ShardingPlanner
 from ..trainer.train_step import (
     TrainState,
@@ -288,6 +293,14 @@ class AccelerateResult:
     loss_fn: Callable
     batch_sharding_fn: Callable  # (ndim, seq_axis) -> NamedSharding
     model: Any = None  # the (possibly strategy-rebuilt) model
+    # warm-restart bookkeeping (auto/compile_cache.py): the framework key
+    # this build registered, whether a prior process already compiled the
+    # same topology (→ the XLA disk cache will serve the step), and the
+    # JSON-able strategy the warm pool can replay (None when the strategy
+    # carries non-serializable payloads, e.g. a head_loss callable)
+    cache_key: str = ""
+    cache_warm: bool = False
+    strategy_spec: Optional[list] = None
 
     def place_batch(self, batch, seq_axis: Optional[int] = None,
                     batch_axis: int = 0):
@@ -382,6 +395,10 @@ def auto_accelerate(
     init for 65B-class models).
     """
     devices = list(devices if devices is not None else jax.devices())
+    # Level-1 warm restarts: every build compiles through the persistent
+    # XLA cache, so a restart on the same topology deserializes from disk
+    # instead of recompiling (idempotent; DWT_COMPILE_CACHE=0 disables)
+    cache_dir = enable_persistent_cache()
     num_params = num_params_hint
     if num_params is None and hasattr(model, "config") and \
             hasattr(model.config, "num_params"):
@@ -423,6 +440,10 @@ def auto_accelerate(
             else type(model)(new_cfg)
         logger.info("sequence parallel: %s attention over sp=%d", sp_impl,
                     ctx.plan.sp)
+
+    # the trace-defining model config, captured before pipeline wrapping
+    # hides it (PipelinedLM's stage slicing is keyed via ctx.extra)
+    cfg_for_key = getattr(model, "config", None)
 
     if ctx.plan.pp > 1:
         # stage-sliced GPipe pipeline over the pp axis (parallel/pipeline.py)
@@ -602,10 +623,85 @@ def auto_accelerate(
                                 else None),
             opt_device_shardings=(dev_sh.opt_state if offload_opt
                                   else None))
-    logger.info("auto_accelerate: mesh=%s params=%s accum=%d",
-                ctx.plan.describe(),
-                f"{num_params:,}" if num_params else "?", ctx.accum_steps)
+    # framework cache key: everything the trace depends on — mesh shape,
+    # the RESOLVED strategy context (not the caller's spelling of it),
+    # the final post-override model config, donation, and the trace-time
+    # env toggles folded in by train_step_cache_key itself
+    cache_key = train_step_cache_key(
+        ctx.plan.sizes(),
+        {"extra": ctx.extra, "amp": ctx.amp, "remat": ctx.remat,
+         "flash_attention": ctx.flash_attention},
+        cfg_for_key,
+        donate=not offload_opt,
+        accum_steps=ctx.accum_steps)
+    cache_warm = note_train_step_served(
+        cache_dir, cache_key,
+        meta={"mesh": ctx.plan.describe(), "n_devices": len(devices)})
+    strategy_spec = _jsonable_strategy(strategy, ctx)
+    if sample_batch is not None and strategy_spec is not None and \
+            cache_dir is not None:
+        # let the agent derive degraded-mesh warm specs without knowing
+        # the model (auto/warm_pool.py; explicit publishing for callers
+        # without a sample_batch: ElasticContext.enable_warm_restarts)
+        _publish_warm_spec(cache_dir, model, strategy_spec, devices,
+                           sample_batch, ctx.accum_steps)
+    logger.info("auto_accelerate: mesh=%s params=%s accum=%d "
+                "cache_key=%s%s", ctx.plan.describe(),
+                f"{num_params:,}" if num_params else "?", ctx.accum_steps,
+                cache_key, " (warm)" if cache_warm else "")
     return AccelerateResult(
         train_step=step, state=state, state_shardings=state_sh, mesh=mesh,
         planner=planner, strategy=ctx, loss_fn=loss,
-        batch_sharding_fn=planner.batch_sharding, model=model)
+        batch_sharding_fn=planner.batch_sharding, model=model,
+        cache_key=cache_key, cache_warm=cache_warm,
+        strategy_spec=strategy_spec)
+
+
+def _jsonable_strategy(strategy: Optional[Sequence],
+                       ctx: StrategyContext) -> Optional[list]:
+    """The strategy in warm-spec (JSON) form; for the auto path the
+    resolved plan is spelled back as explicit axis strategies so a warm
+    child reproduces the exact mesh without re-running auto_plan."""
+    import json as _json
+
+    if not strategy:
+        plan = ctx.plan
+        out = []
+        if plan.tp > 1:
+            out.append(["tensor_parallel", {"size": plan.tp}])
+        if plan.sp > 1:
+            out.append(["sequence_parallel", {"size": plan.sp}])
+        if plan.ep > 1:
+            out.append(["expert_parallel", {"size": plan.ep}])
+        if plan.dp > 1:
+            out.append(["data_parallel", {"size": plan.dp}])
+        out.append(["fsdp", {"size": plan.fsdp}])
+        return out
+    out = []
+    for item in strategy:
+        name, cfg = item if isinstance(item, (tuple, list)) else (item, {})
+        cfg = dict(cfg or {})
+        try:
+            _json.dumps(cfg)
+        except (TypeError, ValueError):
+            return None
+        out.append([name, cfg])
+    return out
+
+
+def _publish_warm_spec(cache_dir: str, model, strategy_spec: list,
+                       devices: Sequence, sample_batch: Dict,
+                       accum_steps: int) -> None:
+    import jax as _jax
+
+    from .warm_pool import WarmSpec, model_spec, publish_current_spec
+
+    mspec = model_spec(model)
+    ids = sample_batch.get("input_ids")
+    if mspec is None or ids is None or getattr(ids, "ndim", 0) < 2:
+        return
+    shape = list(ids.shape[-2:])  # global [batch, seq]
+    publish_current_spec(cache_dir, WarmSpec(
+        n_devices=len(devices), strategy=strategy_spec, model=mspec,
+        batch_shape=[int(s) for s in shape], accum_steps=accum_steps,
+        platform=_jax.default_backend()))

@@ -11,6 +11,7 @@ it is equally a CI test body (tests/test_chaos.py) and an operator tool:
     python -m dlrover_wuqiong_tpu.chaos pod-kill
     python -m dlrover_wuqiong_tpu.chaos straggler
     python -m dlrover_wuqiong_tpu.chaos network-partition
+    python -m dlrover_wuqiong_tpu.chaos preempt-warm  # re-mesh compile win
 
 pod-kill drives the REAL stack — `run` CLI → master → agent → worker with
 flash checkpoints — and hard-SIGKILLs the worker process group externally
@@ -41,9 +42,11 @@ from .common.log import get_logger
 
 logger = get_logger("chaos")
 
+_launch_seq = 0
+
 
 def _launch_standalone(prefix: str, worker_src: str, args,
-                       max_restarts: int):
+                       max_restarts: int, extra_env=None):
     """Shared scaffolding for scenarios that drive the REAL stack: fresh
     workdir + markers, fresh DWT_JOB_NAME / DWT_SOCKET_DIR (CLAUDE.md:
     shm segments and control sockets persist across hard kills), and the
@@ -57,13 +60,19 @@ def _launch_standalone(prefix: str, worker_src: str, args,
     script = os.path.join(work, "worker.py")
     with open(script, "w") as f:
         f.write(worker_src)
-    job = f"{prefix}{os.getpid()}"
+    # unique per INVOCATION, not just per process: preempt-warm runs two
+    # drills back-to-back and a shared name would re-attach the second
+    # run to the first's kill-surviving shm segments (CLAUDE.md)
+    global _launch_seq
+    _launch_seq += 1
+    job = f"{prefix}{os.getpid()}n{_launch_seq}"
     env = dict(
         os.environ, DWT_JOB_NAME=job, JAX_PLATFORMS="cpu",
         DWT_SOCKET_DIR=os.path.join(work, "sockets"),
         PYTHONPATH=os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))) + os.pathsep +
         os.environ.get("PYTHONPATH", ""))
+    env.update(extra_env or {})
     proc = subprocess.Popen(
         [sys.executable, "-m", "dlrover_wuqiong_tpu.run", "--standalone",
          "--nproc_per_node=1", f"--max_restarts={max_restarts}", script,
@@ -295,23 +304,62 @@ def network_partition(heartbeat_timeout: float = 1.5,
 
 
 _PREEMPT_WORKER = r"""
-import os, sys, time
+import json, os, sys, time
 import numpy as np
 
 from dlrover_wuqiong_tpu.trainer.elastic import init_elastic
 from dlrover_wuqiong_tpu.checkpoint.checkpointer import (
     FlashCheckpointer, StorageType)
 
-(ckpt_dir, marker_dir, total_steps, dt, interval, flash) = (
+(ckpt_dir, marker_dir, total_steps, dt, interval, flash, with_model) = (
     sys.argv[1], sys.argv[2], int(sys.argv[3]), float(sys.argv[4]),
-    int(sys.argv[5]), sys.argv[6] == "1")
+    int(sys.argv[5]), sys.argv[6] == "1", sys.argv[7] == "1")
 ctx = init_elastic()
 restart = ctx.world.restart_count
+timing = {"restart": restart, "compile_s": 0.0, "restore_s": 0.0,
+          "cache_warm": False, "step_hits": 0, "step_misses": 0}
+if with_model:
+    # the re-mesh cost under measurement: rebuild + compile the REAL
+    # train step through the persistent cache (auto/compile_cache.py) —
+    # a warm restart deserializes from disk instead of recompiling
+    import dataclasses
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import optax
+    from dlrover_wuqiong_tpu.auto.accelerate import auto_accelerate
+    from dlrover_wuqiong_tpu.auto.compile_cache import counters
+    from dlrover_wuqiong_tpu.models.gpt import GPT, GPTConfig
+
+    cfg = dataclasses.replace(GPTConfig.nano(), dtype=jnp.float32,
+                              use_flash_attention=False, remat=False)
+    h0, m0 = counters.snapshot()
+    t0 = time.time()
+    res = auto_accelerate(GPT(cfg), optimizer=optax.adam(1e-2),
+                          devices=jax.devices(), strategy=[("fsdp", {})])
+    # batch sized by the inherited device count: under pytest the worker
+    # sees the conftest's 8-device XLA_FLAGS and fsdp needs B % n == 0
+    bs = max(4, len(jax.devices()))
+    data = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (bs, 33)).astype(np.int32)
+    b = res.place_batch({"input_ids": jnp.asarray(data[:, :-1]),
+                         "labels": jnp.asarray(data[:, 1:])})
+    st, m = res.train_step(res.state, b)
+    float(m["loss"])  # force the compile + first dispatch
+    h1, m1 = counters.snapshot()
+    timing.update(compile_s=round(time.time() - t0, 3),
+                  cache_warm=res.cache_warm, step_hits=h1 - h0,
+                  step_misses=m1 - m0)
 ckpt = FlashCheckpointer(ckpt_dir, job_name=os.environ["DWT_JOB_NAME"])
 template = {"w": np.zeros((8, 8), np.float32),
             "step": np.zeros((), np.int64)}
+t0 = time.time()
 state = ckpt.load_checkpoint(template)
+timing["restore_s"] = round(time.time() - t0, 3)
 start = int(state["step"]) + 1 if state is not None else 0
+timing["start_step"] = start
+with open(os.path.join(marker_dir, f"timing_r{restart}.json"), "w") as f:
+    json.dump(timing, f)
 with open(os.path.join(marker_dir, f"pid_r{restart}"), "w") as f:
     f.write(str(os.getpid()))
 log = open(os.path.join(marker_dir, "steps.log"), "a")
@@ -338,7 +386,8 @@ with open(os.path.join(marker_dir, "done"), "w") as f:
 def preempt(total_steps: int = 600, dt: float = 0.1,
             ckpt_interval: int = 50, kills: int = 2, seed: int = 0,
             flash: bool = True, target: float = 0.95,
-            timeout: float = 420.0) -> Dict:
+            timeout: float = 420.0, model: bool = False,
+            cache_dir: str = "", compile_cache: bool = True) -> Dict:
     """Randomized preemption drill against the goodput north star.
 
     N SIGKILLs land at seeded-random times over the run; goodput is
@@ -353,14 +402,28 @@ def preempt(total_steps: int = 600, dt: float = 0.1,
     steps, docs/blogs/flash_checkpoint.md:40); `flash=True` additionally
     stages EVERY step to shm, so the agent's save-on-failure persists
     the last step and the loss per kill becomes interval-INDEPENDENT.
+
+    `model=True` makes every worker generation rebuild + compile the
+    REAL train step, so the report's downtime split shows what each
+    restart paid: `compile_s` (re-mesh XLA cost — near zero when the
+    persistent cache serves it), `restore_s` (checkpoint load), and
+    `rework_s` (re-executed steps).  `compile_cache=False` runs the
+    cold-compile control (DWT_COMPILE_CACHE=0); `cache_dir` pins the
+    cache location (fresh dir → first generation cold, restarts warm).
     """
     import random
 
+    extra_env = {}
+    if model:
+        extra_env["DWT_COMPILE_CACHE"] = "1" if compile_cache else "0"
+        if cache_dir:
+            extra_env["DWT_COMPILE_CACHE_DIR"] = cache_dir
     t_start = time.time()
     cli, work, ckpt_dir, marker, job = _launch_standalone(
         "preempt", _PREEMPT_WORKER,
-        [total_steps, dt, ckpt_interval, "1" if flash else "0"],
-        max_restarts=kills + 1)
+        [total_steps, dt, ckpt_interval, "1" if flash else "0",
+         "1" if model else "0"],
+        max_restarts=kills + 1, extra_env=extra_env)
 
     # seeded kill schedule: uniform over the productive middle of the run
     ideal = total_steps * dt
@@ -423,6 +486,30 @@ def preempt(total_steps: int = 600, dt: float = 0.1,
         "wasted_steps": max(0, executed - total_steps),
     }
     report["completed"] = os.path.exists(os.path.join(marker, "done"))
+    # downtime decomposition (one entry per worker generation): what each
+    # restart actually paid — re-mesh compile, checkpoint restore, and
+    # re-executed work.  This is where the warm pool's win shows up as a
+    # number rather than a goodput delta.
+    timings = []
+    for name in os.listdir(marker):
+        if not name.startswith("timing_r"):
+            continue
+        try:
+            with open(os.path.join(marker, name)) as f:
+                timings.append(json.load(f))
+        except (OSError, ValueError):
+            pass
+    timings.sort(key=lambda t: t.get("restart", 0))
+    restarts_t = [t for t in timings if t.get("restart", 0) > 0]
+    report["downtime"] = {
+        "compile_s": round(sum(t["compile_s"] for t in restarts_t), 3),
+        "compile_s_first": (round(timings[0]["compile_s"], 3)
+                            if timings else 0.0),
+        "restore_s": round(sum(t["restore_s"] for t in restarts_t), 3),
+        "rework_s": round(max(0, executed - total_steps) * dt, 3),
+        "warm_restarts": sum(1 for t in restarts_t if t.get("cache_warm")),
+        "restarts": len(restarts_t),
+    }
     # goodput from STEP ACCOUNTING (useful/executed — re-executed steps
     # are the fault's waste); wall-clock goodput reported alongside (it
     # additionally charges restart latency and per-step staging, both of
@@ -446,19 +533,36 @@ def preempt(total_steps: int = 600, dt: float = 0.1,
 def preempt_table(total_steps: int = 600, dt: float = 0.1,
                   kills: int = 2, seed: int = 0) -> Dict:
     """The interval-vs-goodput curve (README): disk-only cadence at
-    several intervals vs flash per-step staging."""
+    several intervals vs flash per-step staging, then two REAL-compile
+    rows (model=True) contrasting warm vs cold restart compile cost —
+    the downtime split makes the warm-pool win visible per-component,
+    not just in aggregate goodput."""
     rows = []
-    for interval, flash in [(200, False), (50, False), (10, False),
-                            (50, True)]:
+    # (interval, flash, model, compile_cache)
+    grid = [(200, False, False, True), (50, False, False, True),
+            (10, False, False, True), (50, True, False, True),
+            (50, True, True, True), (50, True, True, False)]
+    for interval, flash, model, compile_cache in grid:
+        cache = (tempfile.mkdtemp(prefix="dwt-warmtbl-")
+                 if model and compile_cache else "")
         r = preempt(total_steps=total_steps, dt=dt,
                     ckpt_interval=interval, kills=kills, seed=seed,
-                    flash=flash, target=0.0)
-        rows.append({"interval": interval, "flash": flash,
-                     "goodput": r["goodput"],
-                     "wasted_steps": r["wasted_steps"],
-                     "kills_landed": len(r["kills"]),
-                     "completed": r["completed"]})
-        print(json.dumps(rows[-1]), flush=True)
+                    flash=flash, target=0.0, model=model,
+                    cache_dir=cache, compile_cache=compile_cache)
+        row = {"interval": interval, "flash": flash,
+               "goodput": r["goodput"],
+               "wasted_steps": r["wasted_steps"],
+               "kills_landed": len(r["kills"]),
+               "completed": r["completed"]}
+        if model:
+            row["compile_cache"] = compile_cache
+            row["downtime"] = r["downtime"]
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+        if cache:
+            import shutil
+
+            shutil.rmtree(cache, ignore_errors=True)
     # a row where a scheduled kill never landed is NOT a valid curve
     # point — its goodput would be inflated silently
     return {"scenario": "preempt-table", "rows": rows,
@@ -466,9 +570,54 @@ def preempt_table(total_steps: int = 600, dt: float = 0.1,
                       for r in rows)}
 
 
+def preempt_warm(total_steps: int = 120, dt: float = 0.05,
+                 kills: int = 1, seed: int = 1,
+                 timeout: float = 420.0) -> Dict:
+    """Warm-restart proof: identical preemption drills, one compiling
+    through the persistent cache (fresh dir — generation 0 cold, every
+    restart served from disk), one with the cache disabled (every
+    generation recompiles).  The headline number is `compile_s_saved`:
+    the per-re-mesh compile time the warm path reclaims, which is
+    exactly what the goodput accounting charges as dead time."""
+    cache = tempfile.mkdtemp(prefix="dwt-warmdrill-")
+    try:
+        warm = preempt(total_steps=total_steps, dt=dt, ckpt_interval=20,
+                       kills=kills, seed=seed, flash=True, target=0.0,
+                       timeout=timeout, model=True, cache_dir=cache,
+                       compile_cache=True)
+        cold = preempt(total_steps=total_steps, dt=dt, ckpt_interval=20,
+                       kills=kills, seed=seed, flash=True, target=0.0,
+                       timeout=timeout, model=True,
+                       compile_cache=False)
+    finally:
+        import shutil
+
+        shutil.rmtree(cache, ignore_errors=True)
+    saved = round(cold["downtime"]["compile_s"]
+                  - warm["downtime"]["compile_s"], 3)
+    report = {
+        "scenario": "preempt-warm",
+        "warm": {k: warm[k] for k in ("downtime", "goodput",
+                                      "goodput_wall", "completed")},
+        "cold": {k: cold[k] for k in ("downtime", "goodput",
+                                      "goodput_wall", "completed")},
+        "compile_s_saved": saved,
+        "kills_landed": min(len(warm["kills"]), len(cold["kills"])),
+    }
+    report["ok"] = bool(
+        warm["completed"] and cold["completed"]
+        and len(warm["kills"]) == kills and len(cold["kills"]) == kills
+        and warm["downtime"]["warm_restarts"]
+        == warm["downtime"]["restarts"] > 0
+        and cold["downtime"]["warm_restarts"] == 0
+        and saved > 0)
+    return report
+
+
 SCENARIOS = {"pod-kill": pod_kill, "straggler": straggler,
              "network-partition": network_partition,
-             "preempt": preempt, "preempt-table": preempt_table}
+             "preempt": preempt, "preempt-table": preempt_table,
+             "preempt-warm": preempt_warm}
 
 
 def main(argv=None):

@@ -149,32 +149,89 @@ class LocalSocketComm:
                     time.sleep(0.1)
 
 
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except (PermissionError, OSError):
+        pass
+    return True
+
+
 class SharedLock(LocalSocketComm):
-    """Cross-process lock. Parity: reference SharedLock (multi_process.py:225)."""
+    """Cross-process lock. Parity: reference SharedLock (multi_process.py:225).
+
+    Unlike the reference, the holder's PID is tracked and a waiter reaps
+    the lock when the holder process no longer exists: a worker SIGKILLed
+    mid-critical-section (shm staging, elastic_agent relaunch flow) must
+    not wedge the NEXT worker generation for the full acquire timeout —
+    the lock, like the shm segments and sockets around it, outlives hard
+    kills (CLAUDE.md)."""
 
     def __init__(self, name: str, master: bool = False):
         self._lock = threading.Lock() if master else None
+        self._meta = threading.Lock() if master else None
+        self._holder_pid: Optional[int] = None
         super().__init__(f"lock-{name}", master)
+
+    def _try_acquire(self, pid: int) -> bool:
+        with self._meta:
+            if self._lock.acquire(blocking=False):
+                self._holder_pid = pid
+                return True
+            holder = self._holder_pid
+            if holder is not None and not _pid_alive(holder):
+                logger.warning(
+                    "lock %s: holder pid %d is dead — reaping", self._name,
+                    holder)
+                try:
+                    self._lock.release()
+                except RuntimeError:
+                    pass
+                self._lock.acquire(blocking=False)
+                self._holder_pid = pid
+                return True
+            return False
 
     def _handle(self, request):
         op = request["op"]
         if op == "acquire":
-            ok = self._lock.acquire(blocking=request.get("blocking", True),
-                                    timeout=request.get("timeout", -1))
-            return {"ok": ok}
+            pid = int(request.get("pid", 0))
+            if not request.get("blocking", True):
+                return {"ok": self._try_acquire(pid)}
+            timeout = request.get("timeout", -1)
+            deadline = (time.time() + timeout) if timeout and timeout > 0 \
+                else None
+            # poll instead of a blocking Lock.acquire so a holder that
+            # dies WHILE we wait is noticed within one poll interval
+            while True:
+                if self._try_acquire(pid):
+                    return {"ok": True}
+                if deadline is not None and time.time() >= deadline:
+                    return {"ok": False}
+                time.sleep(0.05)
         if op == "release":
-            try:
-                self._lock.release()
-            except RuntimeError:
-                pass
+            with self._meta:
+                try:
+                    self._lock.release()
+                except RuntimeError:
+                    pass
+                self._holder_pid = None
             return {"ok": True}
         if op == "locked":
             return {"ok": self._lock.locked()}
         raise ValueError(op)
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        # client timeout must outlast the server's poll loop, not cut the
+        # socket mid-wait (the server would keep polling for a vanished
+        # waiter and hand it a lock nobody releases)
+        rpc_timeout = max(60.0, timeout + 30.0) if timeout and timeout > 0 \
+            else 7 * 24 * 3600.0
         return self._request({"op": "acquire", "blocking": blocking,
-                              "timeout": timeout})["ok"]
+                              "timeout": timeout, "pid": os.getpid()},
+                             timeout=rpc_timeout)["ok"]
 
     def release(self):
         self._request({"op": "release"})

@@ -61,6 +61,48 @@ class NoopScaler(Scaler):
         logger.info("noop scaler: would remove %s", node)
 
 
+class WarmMeshPolicy:
+    """Scale-plan preference for worlds whose train_step is already
+    compiled (auto/warm_pool.py state, read as plain JSON — no JAX).
+
+    PHOENIX/ElasWave stance (PAPERS.md): when reconfiguration cost is
+    near zero the optimal elastic policy changes.  A degraded world with
+    a ready warm-pool entry restarts in restore-time only, so the master
+    should (a) form it immediately instead of holding the straggler
+    grace window open, and (b) when several target sizes are valid,
+    prefer the largest warm one.  Pool state is host-local; on a
+    multi-host control plane this is the master-host view — agents keep
+    their own pools for the worker-side XLA hit, which is the one that
+    pays.
+    """
+
+    def __init__(self, cache_dir: Optional[str] = None,
+                 devices_per_node_fn: Optional[Callable[[], int]] = None):
+        if cache_dir is None:
+            from ..auto.compile_cache import default_cache_dir
+
+            cache_dir = default_cache_dir()
+        self.cache_dir = cache_dir
+        self._devices_per_node_fn = devices_per_node_fn or (lambda: 1)
+
+    def world_devices(self, n_nodes: int) -> int:
+        return n_nodes * max(1, int(self._devices_per_node_fn()))
+
+    def is_warm_world(self, n_nodes: int) -> bool:
+        from ..auto.warm_pool import warm_device_counts
+
+        counts = warm_device_counts(self.cache_dir)
+        return counts.get(self.world_devices(n_nodes), 0) > 0
+
+    def preferred_world_size(self, candidates) -> Optional[int]:
+        """Largest candidate node count with a warm mesh; None when cold
+        everywhere (no preference — capacity wins)."""
+        for n in sorted(set(candidates), reverse=True):
+            if n > 0 and self.is_warm_world(n):
+                return n
+        return None
+
+
 class JobManager:
     """Tracks training nodes, processes events, decides relaunches."""
 
@@ -242,6 +284,25 @@ class JobManager:
 
     def add_relaunch_listener(self, fn: Callable[[Node, Node], None]):
         self._relaunch_listeners.append(fn)
+
+    # ------------------------------------------------------------- scale plan
+
+    def devices_per_node(self) -> int:
+        """Largest accelerator count any registered node declared (the
+        agent registers nproc_per_node); 1 before any registration."""
+        with self._lock:
+            return max(
+                [n.config_resource.accelerator_num
+                 for n in self._nodes.values()
+                 if n.config_resource.accelerator_num > 0] or [1])
+
+    def make_warm_mesh_policy(self, cache_dir: Optional[str] = None
+                              ) -> WarmMeshPolicy:
+        """Policy bound to this job's observed topology — wired into the
+        rendezvous manager by the master so re-formed worlds prefer
+        already-compiled meshes."""
+        return WarmMeshPolicy(cache_dir=cache_dir,
+                              devices_per_node_fn=self.devices_per_node)
 
     # ------------------------------------------------------------- status
 

@@ -8,6 +8,7 @@ SpeedMonitor/DiagnosisManager + servicer), `master/local_master.py:38`.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Dict, Optional
@@ -58,6 +59,13 @@ class JobMaster:
                 waiting_timeout=5.0 if max_nodes > min_nodes else 0.5,
                 join_timeout=ctx.rdzv_join_timeout,
                 node_unit=node_unit)
+        if os.getenv("DWT_WARM_POOL", "1") != "0":
+            # scale plans prefer meshes the warm pool already compiled
+            # (job_manager.WarmMeshPolicy): a degraded-but-warm world
+            # forms without the straggler grace wait
+            self.rdzv_managers[RendezvousName.ELASTIC_TRAINING] \
+                .set_world_size_policy(
+                    self.job_manager.make_warm_mesh_policy())
         self.kv_store = KVStoreService()
         # uniform failure cleanup regardless of which monitor detected it
         # (watcher event, heartbeat sweep, or explicit failure report) —

@@ -65,6 +65,10 @@ class RendezvousManager(ABC):
         self._start_rdzv_ts = 0.0
         self._alive_nodes: set = set()
         self._node_unit = 1
+        # warm-mesh scale policy (master/job_manager.py WarmMeshPolicy):
+        # when the degraded world's train_step is already compiled, the
+        # straggler grace window buys nothing — form immediately
+        self._world_size_policy = None
 
     def update_rdzv_params(self, min_nodes: int, max_nodes: int,
                            waiting_timeout: float = 30.0,
@@ -119,13 +123,35 @@ class RendezvousManager(ABC):
             # waiting>0 triggers worker restart.
             return len(self._waiting_nodes)
 
+    def set_world_size_policy(self, policy):
+        """Install a warm-mesh preference (WarmMeshPolicy duck type:
+        `is_warm_world(n_nodes) -> bool`)."""
+        with self._lock:
+            self._world_size_policy = policy
+
     def _world_ready(self) -> bool:
         n = len(self._waiting_nodes)
         if n < self._params.min_nodes:
             return False
         if n >= self._params.max_nodes:
             return True
-        # min reached: give stragglers a grace window
+        # min reached but below max: normally give stragglers a grace
+        # window — UNLESS the world these n nodes would form is already
+        # warm (its executable sits in the compile cache), in which case
+        # restarting into it now is near-free and waiting is pure
+        # downtime (the late joiner triggers its own cheap re-form later)
+        if self._world_size_policy is not None:
+            usable = (n // self._node_unit) * self._node_unit
+            if usable >= self._params.min_nodes:
+                try:
+                    if self._world_size_policy.is_warm_world(usable):
+                        logger.info(
+                            "%s: forming %d-node world immediately — "
+                            "mesh is warm in the compile cache",
+                            self.name, usable)
+                        return True
+                except Exception:  # noqa: BLE001 — policy is advisory
+                    logger.debug("warm-mesh policy failed", exc_info=True)
         return (time.time() - self._start_rdzv_ts) > self._params.waiting_timeout
 
     def _form_world(self):

@@ -55,6 +55,7 @@ class ElasticContext:
         self.mc = master_client
         self._step_report_interval = 15.0
         self._last_report = 0.0
+        self._warm_pool = None
 
     @property
     def is_distributed(self) -> bool:
@@ -110,6 +111,58 @@ class ElasticContext:
         return IndexShardingClient(self.mc, dataset_name, batch_size,
                                    dataset_size, **kwargs)
 
+    def enable_warm_restarts(self, result, global_batch: int,
+                             seq_len: int, model=None):
+        """Publish this world's compile spec and start warming the worlds
+        one failure away (auto/warm_pool.py).
+
+        `result` is the AccelerateResult driving training; `global_batch`
+        and `seq_len` pin the abstract batch the degraded compile must
+        match (the framework holds the GLOBAL batch fixed across world
+        changes — GradientAccumulator below).  Returns the WarmPool, or
+        None when the model/strategy cannot be replayed in a warm child
+        (non-registry model, callable-bearing strategy) — warming is an
+        optimization, never a requirement.
+        """
+        import jax
+
+        from ..auto.compile_cache import (
+            active_cache_dir,
+            default_cache_dir,
+        )
+        from ..auto.warm_pool import (
+            WarmPool,
+            WarmSpec,
+            model_spec,
+            publish_current_spec,
+        )
+
+        if getattr(result, "strategy_spec", None) is None:
+            logger.info("warm restarts unavailable: strategy is not "
+                        "replayable in a warm child")
+            return None
+        mspec = model_spec(model if model is not None else result.model)
+        if mspec is None:
+            logger.info("warm restarts unavailable: model not in the "
+                        "warm-pool registry (gpt/llama)")
+            return None
+        cache_dir = active_cache_dir() or default_cache_dir()
+        spec = WarmSpec(
+            n_devices=len(jax.devices()),
+            strategy=result.strategy_spec, model=mspec,
+            batch_shape=[int(global_batch), int(seq_len)],
+            accum_steps=result.strategy.accum_steps,
+            platform=jax.default_backend())
+        publish_current_spec(cache_dir, spec)
+        if self._warm_pool is None:
+            self._warm_pool = WarmPool(cache_dir)
+        local = int(os.getenv(NodeEnv.LOCAL_DEVICE_COUNT, "0")) or \
+            max(1, len(jax.local_devices()))
+        self._warm_pool.warm_degraded(
+            spec, num_nodes=self.world.num_processes,
+            devices_per_node=local)
+        return self._warm_pool
+
 
 _context: Optional[ElasticContext] = None
 
@@ -123,6 +176,12 @@ def init_elastic(connect_master: bool = True) -> ElasticContext:
     if _context is not None:
         return _context
     world = get_world_info()
+    # warm restarts: compile through the persistent cache from the first
+    # trace — a relaunched worker on a known topology then deserializes
+    # its train step from disk instead of recompiling
+    from ..auto.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
     if world.num_processes > 1 and world.coordinator_addr:
         import jax
 
@@ -145,6 +204,8 @@ def reset_elastic_context():
     global _context
     if _context is not None and _context.mc is not None:
         _context.mc.close()
+    if _context is not None and _context._warm_pool is not None:
+        _context._warm_pool.stop()
     _context = None
 
 

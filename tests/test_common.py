@@ -139,6 +139,15 @@ def _queue_worker(in_name, out_name):
     q_out.put({"echo": item})
 
 
+def _lock_and_die_worker(lock_name, out_name):
+    # acquire and exit WITHOUT releasing — the cross-process shape of a
+    # worker SIGKILLed inside its shm-staging critical section
+    lock = SharedLock(lock_name, master=False)
+    q_out = SharedQueue(out_name, master=False)
+    assert lock.acquire(timeout=10)
+    q_out.put("held")
+
+
 class TestIpc:
     def test_shared_lock_same_process(self):
         lock = SharedLock("t1", master=True)
@@ -146,6 +155,34 @@ class TestIpc:
         assert lock.locked()
         lock.release()
         assert not lock.locked()
+        lock.close()
+
+    def test_shared_lock_reaps_dead_holder(self):
+        """A holder that hard-dies mid-critical-section must not wedge
+        the next acquirer for the full timeout (the elastic relaunch
+        path: gen N SIGKILLed while staging, gen N+1 blocks on its first
+        save) — the lock notices the dead pid and is reacquirable."""
+        lock = SharedLock("t1-reap", master=True)
+        q = SharedQueue("t1-reap-out", master=True)
+        proc = mp.get_context("spawn").Process(
+            target=_lock_and_die_worker, args=("t1-reap", "t1-reap-out"))
+        proc.start()
+        assert q.get(timeout=15) == "held"
+        proc.join(timeout=10)
+        assert lock.locked()  # the dead holder left it held
+        t0 = time.time()
+        assert lock.acquire(timeout=30)  # reaped, not waited out
+        assert time.time() - t0 < 5.0
+        lock.release()
+        lock.close()
+        q.close()
+
+    def test_shared_lock_does_not_reap_live_holder(self):
+        lock = SharedLock("t1-live", master=True)
+        assert lock.acquire()  # holder: this (live) process
+        assert not lock.acquire(blocking=False)
+        assert lock.locked()
+        lock.release()
         lock.close()
 
     def test_shared_queue_cross_process(self):

@@ -1,0 +1,85 @@
+"""Warm-pool state probe: ONE JSON line for the driver to snapshot.
+
+Reads only the compile-cache directory's JSON sidecars (no JAX import —
+runs in milliseconds, safe from cron/CI):
+
+    python tools/warm_report.py [cache_dir]
+
+cache_dir defaults to DWT_COMPILE_CACHE_DIR, else the framework default
+(/tmp/dwt-compile-cache-<user>).  Fields:
+
+- warm_meshes: ready warm-pool entries (mesh, device count, compile_s,
+  whether the XLA entry already existed when the pool child compiled)
+- warm_device_counts: {n_devices: ready entries} — what the master's
+  WarmMeshPolicy sees
+- serve: framework-key serve accounting across process generations
+  (warm_hits = auto_accelerate calls whose exact topology a prior
+  process had compiled; pool_hits = serves that found a ready pool
+  entry for their key)
+- cache_entries / cache_dir_bytes: the XLA layer's footprint
+- inflight: warm children still compiling (stale markers expire in 10
+  min — see auto/warm_pool.py)
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    from dlrover_wuqiong_tpu.auto.compile_cache import (
+        cache_dir_bytes,
+        default_cache_dir,
+        pool_dir,
+        registry_entries,
+        serve_stats,
+    )
+    from dlrover_wuqiong_tpu.auto.warm_pool import (
+        WarmPool,
+        warm_device_counts,
+    )
+
+    cache_dir = argv[0] if argv else default_cache_dir()
+    report = {
+        "cache_dir": cache_dir,
+        "exists": os.path.isdir(cache_dir),
+        "warm_meshes": [],
+        "warm_device_counts": {},
+        "serve": {"serves": 0, "warm_hits": 0, "cold_misses": 0,
+                  "pool_hits": 0},
+        "framework_keys": 0,
+        "cache_entries": 0,
+        "cache_dir_bytes": 0,
+        "inflight": 0,
+    }
+    if report["exists"]:
+        pool = WarmPool(cache_dir)
+        status = pool.status()
+        report["warm_meshes"] = [
+            {k: e.get(k) for k in ("mesh", "n_devices", "compile_s",
+                                   "platform", "already_cached")}
+            for e in status["entries"] if e.get("ready")]
+        report["warm_device_counts"] = {
+            str(k): v for k, v in warm_device_counts(cache_dir).items()}
+        report["inflight"] = status["inflight"]
+        report["serve"] = serve_stats(cache_dir)
+        report["framework_keys"] = len(registry_entries(cache_dir))
+        try:
+            report["cache_entries"] = sum(
+                1 for n in os.listdir(cache_dir) if n.endswith("-cache"))
+        except OSError:
+            pass
+        report["cache_dir_bytes"] = cache_dir_bytes(cache_dir)
+        # referenced so a refactor that drops the helper fails HERE, in
+        # the tool that documents it, not silently in the master
+        assert pool_dir(cache_dir)
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
