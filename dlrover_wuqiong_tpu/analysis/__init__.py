@@ -1,0 +1,30 @@
+"""graftlint — static analysis enforcing the repo's hard-won SPMD rules.
+
+Parity: reference `dlrover/python/diagnosis/` + `elastic_agent/monitor/`
+(error_monitor.py:1, node_check.py:1) diagnose distributed failures at
+RUNTIME; graftlint moves the TPU-costly bug classes to a pre-execution
+contract.  Two engines:
+
+- Engine A (`jaxpr_engine`) inspects traced train steps without
+  executing them: collective-in-cond deadlocks, CSE-undone remat,
+  donation vs optimizer_offload aliasing, host-kind out_shardings.
+- Engine B (`ast_engine`) scans source text: trace-time ``DWT_*`` env
+  reads missing from the compile-cache key, donated-buffer reuse,
+  control-plane pickle/fork hygiene, module docstring citations.
+
+CLI: ``python -m dlrover_wuqiong_tpu.analysis [--engine jaxpr|ast|all]
+[path...]`` — single-line JSON summary on stdout (bench.py contract),
+file:line findings on stderr, exit 1 on any finding.  This module and
+Engine B import no jax so ``__graft_entry__.py`` can pre-flight the AST
+checks before any backend initialization; Engine A is imported lazily.
+"""
+
+from .ast_engine import run_paths as run_ast_engine  # noqa: F401
+from .findings import Finding, render_report, summarize  # noqa: F401
+
+
+def run_jaxpr_engine(n_devices: int = 8):
+    """Lazy Engine A entry — imports jax on first use."""
+    from .jaxpr_engine import self_audit
+
+    return self_audit(n_devices)

@@ -1,0 +1,451 @@
+"""graftlint Engine B — Python-AST checks over the package and tests.
+
+Parity: reference `dlrover/python/diagnosis/inferencechain/` precheck
+operators (node_check.py:1, error_monitor.py:1 run AFTER a failure);
+redesign: the four costliest TPU bug classes in this codebase are visible
+in the source text, so they are enforced BEFORE a chip is touched:
+
+- ``env-at-trace``    — a ``DWT_*`` env read inside a function of a
+  compute-path module changes the emitted HLO at TRACE time; any such
+  toggle must be folded into the framework cache key
+  (auto/compile_cache.py:52 ``TRACE_ENV_VARS``), else two processes with
+  different values claim each other's warm entries (CLAUDE.md).
+- ``donated-reuse``   — ``train_step`` / ``apply_sparse_update`` DONATE
+  their state inputs; code that reads the same variable after passing it
+  in observes a dead buffer (CLAUDE.md: copy first in tests).
+- ``control-plane-hygiene`` — the agent↔master frame path
+  (common/comm.py, messages.py, serialize.py) is typed JSON, never
+  pickle; and JAX-initialized processes must spawn, never fork
+  (data/shm_loader.py:127).
+- ``docstring-citation`` — every package module docstring cites the
+  reference files it matches (``file:line``) or carries a ``Parity:``
+  note, the repo's documented convention.
+
+This module is import-light on purpose: NO jax, NO package siblings —
+``__graft_entry__.py`` runs it as a pre-flight gate before any backend
+initialization.  Suppressions: a line containing ``graftlint:
+disable=<checker>`` silences that checker for that line (the in-tree
+self-lint must pass without any).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .findings import Finding
+
+# package subtrees whose functions run under jit/trace: an env read there
+# is a trace-time input (ops/flash_attention.py kernel picks are the
+# canonical case).  trainer/ is split: train_step.py is traced, the
+# Trainer loop around it is host-side orchestration (reads DWT_JOB_NAME
+# etc. legitimately).
+COMPUTE_DIRS = ("ops", "models", "parallel", "optimizers", "embedding")
+COMPUTE_FILES = ("trainer/train_step.py",)
+
+# control-plane modules whose wire format must stay typed JSON
+FRAME_MODULES = ("comm.py", "messages.py", "serialize.py")
+
+# callee name -> (donated positional indices, donated keyword names);
+# positions follow the public signatures (trainer/train_step.py:84,
+# embedding/sparse_optim.py:133)
+DONATING_CALLS: Dict[str, Tuple[Tuple[int, ...], Tuple[str, ...]]] = {
+    "train_step": ((0,), ("state",)),
+    "apply_sparse_update": ((1, 2), ("table", "state")),
+}
+
+_CITE_RE = re.compile(r"[\w/\.-]+\.(?:py|cc|h|proto|md):\d+|\bparity\b",
+                      re.IGNORECASE)
+_DISABLE_RE = re.compile(r"graftlint:\s*disable=([\w,-]+)")
+_ENV_PREFIX = "DWT_"
+
+
+def _suppressed(source_lines: Sequence[str], line: int, checker: str) -> bool:
+    if 0 < line <= len(source_lines):
+        m = _DISABLE_RE.search(source_lines[line - 1])
+        if m and checker in m.group(1).split(","):
+            return True
+    return False
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'res.state' for simple Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _env_var_read(node: ast.Call) -> Optional[str]:
+    """The env-var name when `node` reads one via os.getenv / environ.get."""
+    func = node.func
+    name = func.attr if isinstance(func, ast.Attribute) else (
+        func.id if isinstance(func, ast.Name) else "")
+    if name == "getenv" or (
+            name == "get" and isinstance(func, ast.Attribute)
+            and _dotted(func.value) in ("os.environ", "environ")):
+        if node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            return node.args[0].value
+    return None
+
+
+def _env_var_subscript(node: ast.Subscript) -> Optional[str]:
+    if _dotted(node.value) in ("os.environ", "environ") and \
+            isinstance(node.slice, ast.Constant) and \
+            isinstance(node.slice.value, str):
+        return node.slice.value
+    return None
+
+
+def trace_env_key_vars(package_roots: Iterable[str]) -> Optional[Set[str]]:
+    """Parse TRACE_ENV_VARS out of auto/compile_cache.py (AST, no import).
+
+    Looks under each scanned root, then next to this file's own package —
+    so fixtures can ship their own key-builder and the in-repo scan always
+    finds the real one.
+    """
+    candidates = [os.path.join(r, "auto", "compile_cache.py")
+                  for r in package_roots]
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    candidates.append(os.path.join(here, "auto", "compile_cache.py"))
+    for path in candidates:
+        if not os.path.isfile(path):
+            continue
+        try:
+            tree = ast.parse(open(path).read())
+        except (OSError, SyntaxError):
+            continue
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "TRACE_ENV_VARS"
+                    for t in node.targets):
+                if isinstance(node.value, (ast.Tuple, ast.List, ast.Set)):
+                    return {e.value for e in node.value.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)}
+    return None
+
+
+# --------------------------------------------------------- env-at-trace
+
+
+def check_env_at_trace(path: str, tree: ast.Module,
+                       source_lines: Sequence[str],
+                       key_vars: Set[str]) -> List[Finding]:
+    """DWT_* env reads inside functions of a compute-path module must be
+    in the compile-cache key set — they are trace-time HLO inputs."""
+    posix = path.replace(os.sep, "/")
+    parts = posix.split("/")
+    in_compute = (any(d in parts[:-1] for d in COMPUTE_DIRS)
+                  or any(posix.endswith(f) for f in COMPUTE_FILES))
+    if not in_compute or "tests" in parts:
+        return []
+    findings: List[Finding] = []
+
+    def visit(node: ast.AST, in_func: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            child_in_func = in_func or isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef))
+            var = None
+            if isinstance(child, ast.Call):
+                var = _env_var_read(child)
+            elif isinstance(child, ast.Subscript):
+                var = _env_var_subscript(child)
+            if var and var.startswith(_ENV_PREFIX) and child_in_func \
+                    and var not in key_vars \
+                    and not _suppressed(source_lines, child.lineno,
+                                        "env-at-trace"):
+                findings.append(Finding(
+                    "env-at-trace",
+                    f"{var} read inside a compute-path function but absent "
+                    f"from TRACE_ENV_VARS (auto/compile_cache.py) — two "
+                    f"processes with different values would share one "
+                    f"framework cache key over different HLO",
+                    path, child.lineno,
+                    rule="trace-time env toggles must be in the compile "
+                         "cache key"))
+            visit(child, child_in_func)
+
+    visit(tree, in_func=False)
+    return findings
+
+
+# -------------------------------------------------------- donated-reuse
+
+
+class _Scope:
+    """Per-function bookkeeping for the donated-reuse dataflow."""
+
+    def __init__(self) -> None:
+        self.stores: Dict[str, List[int]] = {}   # root name -> linenos
+        self.loads: Dict[str, List[int]] = {}    # dotted path -> linenos
+
+
+def _collect_scope(fn: ast.AST) -> Tuple[_Scope, List[Tuple[ast.Call, str,
+                                                            List[ast.AST]]]]:
+    scope = _Scope()
+    donating: List[Tuple[ast.Call, str, List[ast.AST]]] = []
+
+    def record_store(name: str, line: int) -> None:
+        scope.stores.setdefault(name, []).append(line)
+
+    def visit(node: ast.AST, loops: List[ast.AST]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and child is not fn:
+                continue  # nested scopes tracked separately
+            if isinstance(child, ast.Name):
+                if isinstance(child.ctx, (ast.Store, ast.Del)):
+                    record_store(child.id, child.lineno)
+                else:
+                    scope.loads.setdefault(child.id, []).append(child.lineno)
+            elif isinstance(child, ast.Attribute):
+                dotted = _dotted(child)
+                if dotted and "." in dotted:
+                    if isinstance(child.ctx, (ast.Store, ast.Del)):
+                        # `self.state, m = ...` rebinds the attribute: a
+                        # kill for the dotted path (but not its root)
+                        record_store(dotted, child.lineno)
+                    else:
+                        scope.loads.setdefault(dotted,
+                                               []).append(child.lineno)
+            elif isinstance(child, ast.Call):
+                func = child.func
+                callee = func.attr if isinstance(func, ast.Attribute) else (
+                    func.id if isinstance(func, ast.Name) else "")
+                if callee in DONATING_CALLS:
+                    donating.append((child, callee, list(loops)))
+            child_loops = loops + [child] if isinstance(
+                child, (ast.For, ast.While, ast.AsyncFor)) else loops
+            visit(child, child_loops)
+
+    visit(fn, [])
+    return scope, donating
+
+
+def _donated_args(call: ast.Call, callee: str) -> List[ast.AST]:
+    pos, kw = DONATING_CALLS[callee]
+    out = [call.args[i] for i in pos if i < len(call.args)]
+    out += [k.value for k in call.keywords if k.arg in kw]
+    return out
+
+
+def check_donated_reuse(path: str, tree: ast.Module,
+                        source_lines: Sequence[str]) -> List[Finding]:
+    """A variable passed to a donating jit must not be read afterwards."""
+    findings: List[Finding] = []
+    # the module body is a scope too — example scripts donate at top level
+    fns: List[ast.AST] = [tree]
+    fns += [n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    for fn in fns:
+        scope, donating = _collect_scope(fn)
+        for call, callee, loops in donating:
+            if _suppressed(source_lines, call.lineno, "donated-reuse"):
+                continue
+            for arg in _donated_args(call, callee):
+                dotted = _dotted(arg)
+                if dotted is None:
+                    continue  # fresh expression (jnp.copy(x), literal, ...)
+                root = dotted.split(".")[0]
+                kill_lines = scope.stores.get(root, []) + \
+                    scope.stores.get(dotted, [])
+                call_end = getattr(call, "end_lineno", call.lineno) \
+                    or call.lineno
+                # (a) read after the donating call with no reassignment
+                for load_line in scope.loads.get(dotted, []):
+                    if load_line <= call_end:
+                        continue
+                    if any(call.lineno <= k <= load_line
+                           for k in kill_lines):
+                        continue
+                    if _suppressed(source_lines, load_line,
+                                   "donated-reuse"):
+                        continue
+                    findings.append(Finding(
+                        "donated-reuse",
+                        f"`{dotted}` is read at line {load_line} after "
+                        f"being DONATED to {callee}() — the buffer is dead"
+                        f"; copy first (jnp.copy) or rebind the name",
+                        path, load_line,
+                        rule="train_step/apply_sparse_update donate their "
+                             "inputs"))
+                    break  # one finding per donated arg is enough
+                # (b) re-donated on the next loop iteration unchanged
+                if loops:
+                    loop = loops[-1]
+                    end = max((getattr(n, "lineno", loop.lineno)
+                               for n in ast.walk(loop)),
+                              default=loop.lineno)
+                    if not any(loop.lineno <= k <= end for k in kill_lines):
+                        findings.append(Finding(
+                            "donated-reuse",
+                            f"`{dotted}` is donated to {callee}() inside a "
+                            f"loop but never reassigned in the loop body — "
+                            f"the next iteration passes a dead buffer",
+                            path, call.lineno,
+                            rule="train_step/apply_sparse_update donate "
+                                 "their inputs"))
+    return findings
+
+
+# ----------------------------------------------- control-plane-hygiene
+
+
+def check_control_plane_hygiene(path: str, tree: ast.Module,
+                                source_lines: Sequence[str]
+                                ) -> List[Finding]:
+    """No pickle on the typed-JSON frame path; spawn, never fork."""
+    findings: List[Finding] = []
+    parts = path.replace(os.sep, "/").split("/")
+    frame_path = parts[-1] in FRAME_MODULES and "common" in parts
+    imports_jax = any(
+        (isinstance(n, ast.Import)
+         and any(a.name.split(".")[0] == "jax" for a in n.names))
+        or (isinstance(n, ast.ImportFrom) and n.module
+            and n.module.split(".")[0] == "jax")
+        for n in ast.walk(tree))
+
+    for node in ast.walk(tree):
+        line = getattr(node, "lineno", 0)
+        if _suppressed(source_lines, line, "control-plane-hygiene"):
+            continue
+        if frame_path and isinstance(node, (ast.Import, ast.ImportFrom)):
+            mods = [a.name for a in node.names] if isinstance(
+                node, ast.Import) else [node.module or ""]
+            for mod in mods:
+                if mod.split(".")[0] in ("pickle", "cloudpickle", "dill"):
+                    findings.append(Finding(
+                        "control-plane-hygiene",
+                        f"`{mod}` imported on the control-plane frame path "
+                        f"({parts[-1]}) — the wire format is typed JSON "
+                        f"frames, never pickle",
+                        path, line,
+                        rule="control plane is typed JSON frames"))
+        if isinstance(node, ast.Call):
+            func = node.func
+            callee = func.attr if isinstance(func, ast.Attribute) else (
+                func.id if isinstance(func, ast.Name) else "")
+            first = node.args[0].value if node.args and isinstance(
+                node.args[0], ast.Constant) else None
+            if callee in ("get_context", "set_start_method") and \
+                    first == "fork":
+                findings.append(Finding(
+                    "control-plane-hygiene",
+                    f"{callee}('fork') — fork from a JAX-initialized "
+                    f"(multithreaded) process deadlocks; use 'spawn' "
+                    f"(data/shm_loader.py)",
+                    path, line, rule="spawn, never fork"))
+            elif callee == "fork" and isinstance(func, ast.Attribute) and \
+                    _dotted(func.value) == "os":
+                findings.append(Finding(
+                    "control-plane-hygiene",
+                    "os.fork() — fork from a JAX-initialized process "
+                    "deadlocks; use a spawn context",
+                    path, line, rule="spawn, never fork"))
+            elif callee in ("Process", "Pool") and imports_jax and \
+                    isinstance(func, ast.Attribute) and \
+                    _dotted(func.value) in ("multiprocessing", "mp"):
+                findings.append(Finding(
+                    "control-plane-hygiene",
+                    f"bare multiprocessing.{callee}() in a jax-importing "
+                    f"module defaults to fork on Linux — use "
+                    f"get_context('spawn').{callee}",
+                    path, line, rule="spawn, never fork"))
+    return findings
+
+
+# ------------------------------------------------- docstring-citation
+
+
+def check_docstring_citation(path: str, tree: ast.Module,
+                             source_lines: Sequence[str],
+                             in_package: Optional[bool] = None
+                             ) -> List[Finding]:
+    """Package modules with code must cite their reference (`file:line`).
+
+    Scoped to files living inside a python package (a dir with
+    __init__.py) — bench.py / tools/ scripts document themselves freely.
+    """
+    parts = path.replace(os.sep, "/").split("/")
+    if parts[-1] == "__init__.py" or "tests" in parts:
+        return []
+    if in_package is None:
+        in_package = os.path.isfile(os.path.join(
+            os.path.dirname(os.path.abspath(path)), "__init__.py"))
+    if not in_package:
+        return []
+    has_code = any(isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)) for n in tree.body)
+    if not has_code:
+        return []
+    if _suppressed(source_lines, 1, "docstring-citation"):
+        return []
+    doc = ast.get_docstring(tree) or ""
+    if _CITE_RE.search(doc):
+        return []
+    what = "has no module docstring" if not doc else \
+        "docstring cites no reference file:line (and carries no Parity note)"
+    return [Finding(
+        "docstring-citation",
+        f"module {what} — the repo convention is to cite the matched "
+        f"reference files and explain the TPU redesign",
+        path, 1, rule="every module docstring cites its reference")]
+
+
+# ------------------------------------------------------------- driver
+
+
+def iter_python_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            out.append(p)
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs
+                           if d not in ("__pycache__", ".git")]
+                out.extend(os.path.join(root, f)
+                           for f in sorted(files) if f.endswith(".py"))
+    return sorted(set(out))
+
+
+def run_paths(paths: Sequence[str],
+              checkers: Optional[Sequence[str]] = None,
+              key_vars: Optional[Set[str]] = None
+              ) -> Tuple[List[Finding], int]:
+    """Run the AST engine over files/dirs; returns (findings, files_scanned).
+
+    `checkers` filters by name; `key_vars` overrides the TRACE_ENV_VARS
+    set (parsed from auto/compile_cache.py when None).
+    """
+    if key_vars is None:
+        key_vars = trace_env_key_vars(paths) or set()
+    files = iter_python_files(paths)
+    findings: List[Finding] = []
+    for path in files:
+        try:
+            source = open(path).read()
+            tree = ast.parse(source)
+        except (OSError, SyntaxError) as e:
+            findings.append(Finding("parse-error", str(e), path, 0))
+            continue
+        lines = source.splitlines()
+        rel = os.path.relpath(path)
+        if not checkers or "env-at-trace" in checkers:
+            findings.extend(check_env_at_trace(rel, tree, lines, key_vars))
+        if not checkers or "donated-reuse" in checkers:
+            findings.extend(check_donated_reuse(rel, tree, lines))
+        if not checkers or "control-plane-hygiene" in checkers:
+            findings.extend(
+                check_control_plane_hygiene(rel, tree, lines))
+        if not checkers or "docstring-citation" in checkers:
+            findings.extend(check_docstring_citation(rel, tree, lines))
+    return findings, len(files)

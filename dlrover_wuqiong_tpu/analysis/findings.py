@@ -1,0 +1,50 @@
+"""Finding model shared by both graftlint engines.
+
+Parity: reference `dlrover/python/diagnosis/common/diagnosis_action.py`
+style typed results (the runtime diagnosis stack reports observations as
+structured objects, `diagnosis/diagnostician.py:1` here) — graftlint moves
+the same idea BEFORE execution: each hard-won SPMD rule from CLAUDE.md
+becomes a checker that emits `Finding`s from a trace or an AST instead of
+from a crashed job.  Dependency-free on purpose: the AST engine must be
+importable without initializing jax (`__graft_entry__.py` pre-flight).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation, anchored to a file:line when known."""
+
+    checker: str          # e.g. "env-at-trace"
+    message: str          # human-readable, names the offending symbol
+    path: str = ""        # repo-relative when possible
+    line: int = 0         # 1-based; 0 = not file-anchored (jaxpr findings)
+    rule: str = ""        # the CLAUDE.md rule this enforces, one line
+
+    def location(self) -> str:
+        if self.path and self.line:
+            return f"{self.path}:{self.line}"
+        return self.path or "<trace>"
+
+    def format(self) -> str:
+        return f"{self.location()}: [{self.checker}] {self.message}"
+
+
+def summarize(findings: List[Finding]) -> Dict[str, int]:
+    """Per-checker counts for the single-line JSON summary."""
+    out: Dict[str, int] = {}
+    for f in findings:
+        out[f.checker] = out.get(f.checker, 0) + 1
+    return dict(sorted(out.items()))
+
+
+def render_report(findings: List[Finding],
+                  limit: Optional[int] = None) -> str:
+    lines = [f.format() for f in findings[:limit]]
+    if limit is not None and len(findings) > limit:
+        lines.append(f"... and {len(findings) - limit} more")
+    return "\n".join(lines)

@@ -29,6 +29,10 @@ from ..parallel.mesh import (
     build_mesh,
     detect_hbm_per_device,
 )
+from ..analysis.jaxpr_engine import (
+    assert_no_host_out_shardings,
+    resolve_donation,
+)
 from .compile_cache import (
     enable_persistent_cache,
     note_train_step_served,
@@ -383,8 +387,16 @@ def auto_accelerate(
     num_params_hint: Optional[int] = None,
     seq_len: int = 0,
     materialize: bool = True,
+    donate: Optional[bool] = None,
 ) -> AccelerateResult:
     """Analyse → resolve strategy → build mesh → shard state → compile step.
+
+    `donate=None` (default) resolves automatically: the train step donates
+    its input state unless the strategy forbids it (optimizer_offload
+    would alias a pinned_host input onto a device output — CLAUDE.md).
+    An explicit `donate=True` that conflicts with the resolved strategy
+    raises `ValueError` here, before any parameter init (graftlint
+    donation-alias check, analysis/jaxpr_engine.py).
 
     `materialize=False` returns ABSTRACT state: every leaf a
     ShapeDtypeStruct carrying its NamedSharding, nothing allocated.  The
@@ -407,6 +419,10 @@ def auto_accelerate(
                            hbm_per_device=detect_hbm_per_device(devices))
     if accum_steps:
         ctx.accum_steps = accum_steps
+    # resolve-time lint gate: an impossible donation request fails HERE,
+    # before model init burns work on a doomed config (strategy-matrix
+    # convention; graftlint donation-alias)
+    donate = resolve_donation(ctx.extra, donate)
     overrides = ctx.model_overrides(model)
     if overrides:
         # rebuild the model with the strategy's amp/remat/flash flags
@@ -526,6 +542,7 @@ def auto_accelerate(
 
         p_abs = jax.eval_shape(_init_params, rng)
         p_sh = planner.param_shardings(p_abs)
+        assert_no_host_out_shardings(p_sh, where="local_sgd param init")
         params = jax.jit(_init_params, out_shardings=p_sh)(rng)
         # DiLoCo two-level training (parallel/local_sgd.py): the dp axis
         # becomes the replica-group axis that only syncs every H steps
@@ -607,10 +624,14 @@ def auto_accelerate(
             # jit-init cannot emit host-memory outputs under SPMD (the
             # device-placement annotation defeats the partitioner), so
             # init lands on device shardings and the moments hop to
-            # pinned_host right after — a one-time transfer at init
+            # pinned_host right after — a one-time transfer at init.
+            # graftlint enforces the invariant: the tree handed to jit
+            # must be device-kind (host-kind-out-shardings check).
+            assert_no_host_out_shardings(dev_sh, where="offload state init")
             state = jax.jit(_create_state, out_shardings=dev_sh)(rng)
             state = jax.device_put(state, state_sh)
         else:
+            assert_no_host_out_shardings(state_sh, where="state init")
             state = jax.jit(_create_state, out_shardings=state_sh)(rng)
         vg_fn = None
         if ctx.plan.pp > 1 and ctx.extra.get("pp_schedule") == "1f1b":
@@ -618,6 +639,7 @@ def auto_accelerate(
             vg_fn = model.value_and_grad
         step = make_train_step(
             loss, optimizer, mesh, planner, accum_steps=ctx.accum_steps,
+            donate=donate,
             value_and_grad_fn=vg_fn,
             opt_host_shardings=(state_sh.opt_state if offload_opt
                                 else None),
@@ -632,7 +654,7 @@ def auto_accelerate(
         {"extra": ctx.extra, "amp": ctx.amp, "remat": ctx.remat,
          "flash_attention": ctx.flash_attention},
         cfg_for_key,
-        donate=not offload_opt,
+        donate=donate,
         accum_steps=ctx.accum_steps)
     cache_warm = note_train_step_served(
         cache_dir, cache_key,

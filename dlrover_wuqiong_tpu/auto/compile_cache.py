@@ -48,8 +48,11 @@ logger = get_logger("compile_cache")
 
 # trace-time env toggles that change the emitted HLO (kernel path picks,
 # CLAUDE.md): part of the framework cache key, and forwarded verbatim to
-# warm-pool children so speculative compiles match the worker's trace
-TRACE_ENV_VARS = ("DWT_FA_NO_FUSED", "DWT_FA_STREAMED")
+# warm-pool children so speculative compiles match the worker's trace.
+# DWT_FA_PACK picks the flash-attention sublane pack width at trace time
+# (ops/flash_attention.py:225) — found missing by graftlint's env-at-trace
+# checker; the analysis/ self-lint keeps this tuple honest from here on.
+TRACE_ENV_VARS = ("DWT_FA_NO_FUSED", "DWT_FA_PACK", "DWT_FA_STREAMED")
 
 # one registry sidecar + one pool directory per cache dir
 _REGISTRY_SUBDIR = "framework-keys"

@@ -1,4 +1,15 @@
-"""Small shared helpers."""
+"""Small shared helpers for the axon-tunnel measurement rules.
+
+Parity: no single reference counterpart — the reference assumes local
+CUDA devices where `torch.cuda.synchronize()` is truthful; over the axon
+TPU tunnel `block_until_ready()` is a NO-OP (CLAUDE.md), so every timing
+or liveness probe in this repo funnels through these helpers instead:
+`sync_tree` (one-dispatch whole-tree host readback, bench.py:1 and the
+checkpoint timers), `measure_h2d_gbps` (the resolve-time slow-link probe
+behind auto/accelerate.py:330 offload warnings), and `is_oom_error`
+(typed RESOURCE_EXHAUSTED detection shared by bench.py fallbacks and
+auto/engine.py candidate scoring).
+"""
 
 from __future__ import annotations
 

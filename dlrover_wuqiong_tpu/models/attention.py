@@ -1,10 +1,15 @@
 """Attention dispatch for model modules: flash / ring / Ulysses.
 
-The model config carries `attn_impl` ("flash" | "ring" | "ulysses") and,
-for the SP impls, the `mesh` whose `sp` axis shards the sequence.  The
-`sequence_parallel` strategy (auto/accelerate.py) rewrites these fields so
-the same model definition runs single-chip, GSPMD-sharded, or
-context-parallel without code changes.
+Parity: reference module-replace optimization swapping attention impls
+in place (atorch `auto/opt_lib/module_replace_optimization.py:1-120`
+REPLACEMENT_PAIRS) and its distributed attention dispatch
+(`modules/distributed_modules/transformer.py:1`).  TPU redesign: instead
+of swapping nn.Module classes post-hoc, the model config carries
+`attn_impl` ("flash" | "ring" | "ulysses") and, for the SP impls, the
+`mesh` whose `sp` axis shards the sequence.  The `sequence_parallel`
+strategy (auto/accelerate.py:424) rewrites these fields so the same
+model definition runs single-chip, GSPMD-sharded, or context-parallel
+(parallel/long_context.py) without code changes.
 """
 
 from __future__ import annotations

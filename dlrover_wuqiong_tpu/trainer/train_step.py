@@ -101,8 +101,18 @@ def make_train_step(
 
     # offloaded opt states: donation would let XLA alias a pinned_host
     # input buffer onto a device-memory output (same shape/dtype) and the
-    # runtime rejects the memory-kind mismatch — keep donation off there
-    donate_argnums = (0,) if donate and opt_host_shardings is None else ()
+    # runtime rejects the memory-kind mismatch.  Silently disabling the
+    # flag hid the conflict from callers; now it is an explicit resolve-
+    # time error (graftlint donation-alias — auto_accelerate resolves
+    # donate=None to the right value before calling here).
+    if donate and opt_host_shardings is not None:
+        raise ValueError(
+            "graftlint[donation-alias]: donate=True with host-offloaded "
+            "optimizer state — XLA would alias a pinned_host input onto a "
+            "device-memory output and the runtime rejects the memory-kind "
+            "mismatch; pass donate=False (auto_accelerate's donate=None "
+            "resolves this automatically)")
+    donate_argnums = (0,) if donate else ()
     return jax.jit(train_step, donate_argnums=donate_argnums)
 
 

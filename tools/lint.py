@@ -1,0 +1,18 @@
+"""Thin CI wrapper for graftlint (`python tools/lint.py [args...]`).
+
+Same contract as bench.py: one JSON line on stdout, details on stderr,
+non-zero exit on findings.  Exists so CI configs and the dryrun driver
+can call a stable path without knowing the package layout; all logic
+lives in dlrover_wuqiong_tpu/analysis/__main__.py.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+from dlrover_wuqiong_tpu.analysis.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
