@@ -42,22 +42,27 @@ import jax.numpy as jnp
 BASELINE_TOKENS_PER_SEC = 150_000.0  # nanoGPT GPT-2 124M on A100, bf16
 
 
-def _init_backend_with_retry(attempts: int = 3, base_delay_s: float = 5.0):
-    """First device query with bounded backoff (5s, 10s, then fail).
+def _init_backend_with_retry(attempts: int = 3, base_delay_s: float = 5.0,
+                             probe=None):
+    """First backend touch with bounded backoff (5s, 10s, then fail).
 
     A transient axon-tunnel outage at startup previously produced an
     rc-1 artifact with no benchmark line (BENCH_r05.json); three tries
     with the backend torn down in between ride out a blip without
     masking a real outage.  All retry chatter goes to stderr — stdout
-    stays the single JSON line."""
+    stays the single JSON line.  EVERY backend touch goes through here
+    (`probe` defaults to jax.devices; main's backend-name query passes
+    jax.default_backend) so no call path can die with a raw traceback
+    before the JSON contract is emitted."""
+    probe = probe if probe is not None else jax.devices
     last = None
     for attempt in range(attempts):
         try:
-            devices = jax.devices()
+            out = probe()
             if attempt:
                 print(json.dumps({"backend_init_recovered_attempt":
                                   attempt + 1}), file=sys.stderr)
-            return devices
+            return out
         except Exception as e:  # noqa: BLE001 — backend init has no
             # stable exception type across plugins (RuntimeError,
             # XlaRuntimeError, grpc errors through the tunnel)
@@ -103,6 +108,27 @@ def measure_matmul_ceiling(n: int = 8192, iters: int = 20) -> float:
 
 
 def main():
+    """One JSON line on stdout, ALWAYS — even a still-down tunnel after
+    the bounded retries emits the contract with an `error` field instead
+    of a raw traceback (round-5 bench died rc=1 with unparseable
+    output).  The traceback still goes to stderr for debugging."""
+    try:
+        _main()
+    except Exception as e:  # noqa: BLE001 — the contract beats purity
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        print(json.dumps({
+            "metric": "gpt2_124m_tokens_per_sec_per_chip",
+            "value": None,
+            "unit": "tokens/s",
+            "vs_baseline": None,
+            "error": repr(e)[:500],
+        }))
+        sys.exit(1)
+
+
+def _main():
     import dataclasses
 
     import optax
@@ -111,7 +137,7 @@ def main():
     from dlrover_wuqiong_tpu.models.gpt import GPT, GPTConfig
 
     _init_backend_with_retry()
-    backend = jax.default_backend()
+    backend = _init_backend_with_retry(probe=jax.default_backend)
     on_tpu = backend == "tpu"
     if on_tpu:
         # 124M fits 16GB HBM with full activations — remat would pay a full
@@ -171,6 +197,16 @@ def main():
     # side metrics → stderr
     side = {"backend": backend, "seq": seq, "batch": batch,
             "step_ms": dt / steps * 1e3}
+
+    # fused K-step dispatch vs the per-step driver (ISSUE 3 tentpole):
+    # measured on every backend — on CPU the dispatch overhead IS the
+    # step time at nano scale, on the tunnel it is the 5-8ms fixed tax
+    fused_report = {}
+    try:
+        fused_report = _fused_vs_perstep(res, cfg, batch, seq, state)
+        side.update(fused_report)
+    except Exception as e:  # noqa: BLE001
+        side["fused_error"] = repr(e)[:300]
     flops_per_token = None
     if n_params:
         side["params"] = n_params
@@ -301,12 +337,106 @@ def main():
             side["fp8_error"] = repr(e)
 
     print(json.dumps(side), file=sys.stderr)
-    print(json.dumps({
+    line = {
         "metric": "gpt2_124m_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(tokens_per_sec / BASELINE_TOKENS_PER_SEC, 3),
-    }))
+    }
+    if fused_report:
+        # the fused driver next to the per-step number, same line: the
+        # dispatch-amortization win must be visible in the artifact
+        line.update({k: fused_report[k] for k in
+                     ("fused_tokens_per_s", "fused_steps",
+                      "perstep_driver_tokens_per_s", "fused_vs_perstep")})
+    print(json.dumps(line))
+
+
+def _fused_vs_perstep(res, cfg, batch, seq, state):
+    """Fused K-step driver vs the per-step driver, same model and batch.
+
+    The per-step driver is the unfused trainer hot path: place one batch,
+    one dispatch, one blocking metrics readback PER STEP.  The fused
+    driver stages K batches in one stacked device_put, runs one K-step
+    scan dispatch, and reads metrics back once per fusion
+    (trainer/train_step.py).  The ratio is the dispatch-amortization win
+    this environment leaves on the table at this step size.
+
+    Honest bound, measured 2026-08: on LOCAL XLA:CPU the removable
+    per-step overhead (place + python dispatch + readback) is ~1ms while
+    the nano step floor is ~8ms of IN-executable op overhead, so the
+    ratio tops out around 1.1-1.15x here — the 5-8ms fixed dispatch +
+    full-RTT readback of the axon tunnel (CLAUDE.md) is the environment
+    where the fused driver is decisive (projected 1.5-3x at nano step
+    times; `tools/perf_probe.py dispatch` measures it per environment)."""
+    import numpy as np
+
+    from dlrover_wuqiong_tpu.data.elastic_dataset import stack_batches
+    from dlrover_wuqiong_tpu.trainer.train_step import auto_fused_steps
+
+    # On CPU the comparison runs the most dispatch-BOUND nano regime
+    # (batch 1, short seq): the smaller the step, the larger the share
+    # of fixed per-step overhead — exactly the regime the fused driver
+    # exists for.  On TPU the headline batch is kept (re-lowering 124M
+    # for a new shape costs minutes over the tunnel; the 5-8ms dispatch
+    # tax is large anyway).
+    if jax.default_backend() != "tpu":
+        batch = 1
+        seq = min(32, seq)
+    rng = np.random.default_rng(17)
+    x = rng.integers(0, cfg.vocab_size, (batch, seq + 1), dtype=np.int32)
+    hb = {"input_ids": x[:, :-1], "labels": x[:, 1:]}
+    # ~10ms CPU nano steps need >100 samples for a stable ratio; 24 of
+    # the ~200ms TPU steps are plenty
+    steps = 24 if jax.default_backend() == "tpu" else 120
+
+    st = jax.tree.map(jnp.copy, state)
+    b = res.place_batch(dict(hb))
+    st, m = res.train_step(st, b)
+    float(m["loss"])  # warm/compile this batch shape
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        b = res.place_batch(dict(hb))
+        st, m = res.train_step(st, b)
+        # the per-step sync under measurement: this driver's cost IS the
+        # rule the linter enforces, so the suppression is the point
+        float(m["loss"])  # graftlint: disable=blocking-readback
+    per_step_s = (time.perf_counter() - t0) / steps
+
+    # chained reference (batch pre-placed, one readback for the whole
+    # run) isolates THIS step's real per-dispatch + readback overhead —
+    # the scalar probe underestimates it badly for a many-leaf state
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        st, m = res.train_step(st, b)
+    float(m["loss"])
+    chain_step_s = (time.perf_counter() - t0) / steps
+    overhead_s = max(per_step_s - chain_step_s, 0.0)
+    k = auto_fused_steps(chain_step_s, overhead_s=overhead_s, cap=32)
+    # always exercise the fused path: auto-tune picks small K when
+    # dispatch is already amortized (local CPU), but the comparison's
+    # point is the fully-amortized regime — floor K at 8 off-TPU (the
+    # sub-ms measured overhead makes the <2% target trivially reachable,
+    # and a 2-step fusion under-reports the removable share)
+    k = max(k, 2 if jax.default_backend() == "tpu" else 8)
+    fused_fn = res.fused_train_step(k)
+    blocks = max(2, steps // k)
+    fb = res.place_fused_batch(stack_batches([hb] * k))
+    st, m = fused_fn(st, fb)
+    float(m["loss"])  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(blocks):
+        fb = res.place_fused_batch(stack_batches([hb] * k))
+        st, m = fused_fn(st, fb)
+        float(m["loss"])  # ONE readback syncs the whole K-step fusion
+    fused_step_s = (time.perf_counter() - t0) / (blocks * k)
+    return {
+        "fused_steps": k,
+        "dispatch_overhead_ms": round(overhead_s * 1e3, 3),
+        "perstep_driver_tokens_per_s": round(batch * seq / per_step_s, 1),
+        "fused_tokens_per_s": round(batch * seq / fused_step_s, 1),
+        "fused_vs_perstep": round(per_step_s / fused_step_s, 3),
+    }
 
 
 def _bench_produce(vocab, batch, seq, worker_id, step):

@@ -20,12 +20,21 @@ in the source text, so they are enforced BEFORE a chip is touched:
 - ``docstring-citation`` — every package module docstring cites the
   reference files it matches (``file:line``) or carries a ``Parity:``
   note, the repo's documented convention.
+- ``blocking-readback`` — an UNCONDITIONAL ``float(...)`` /
+  ``np.asarray(...)`` / ``device_get`` on a train-step output inside a
+  training loop forces one host sync PER STEP; over the axon tunnel
+  each sync is a full round trip, and it defeats the fused K-step
+  driver's one-readback-per-fusion contract (CLAUDE.md dispatch
+  amortization; trainer/train_step.py).  Cadence-gated readbacks
+  (under an ``if`` — e.g. logging every N steps) are fine.
 
 This module is import-light on purpose: NO jax, NO package siblings —
 ``__graft_entry__.py`` runs it as a pre-flight gate before any backend
 initialization.  Suppressions: a line containing ``graftlint:
 disable=<checker>`` silences that checker for that line (the in-tree
-self-lint must pass without any).
+self-lint must pass with suppressions reserved for intentional,
+documented cases — e.g. bench.py's measured per-step driver, whose
+whole point is the per-step sync the rule exists to catch).
 """
 
 from __future__ import annotations
@@ -296,6 +305,124 @@ def check_donated_reuse(path: str, tree: ast.Module,
     return findings
 
 
+# ----------------------------------------------- blocking-readback
+
+# callee names that advance the training hot loop; assignments fed by a
+# call to one of these mark their targets as step outputs (device values)
+STEP_ADVANCING_CALLS = ("train_step", "fused_train_step")
+# callee names that force a blocking host readback of their argument
+READBACK_CALLS = ("float", "asarray", "device_get")
+
+
+def _terminal_callee(func: ast.AST) -> str:
+    """Terminal name of a call target, through immediately-invoked
+    factories: `res.train_step(...)`, `res.fused_train_step(k)(...)`."""
+    if isinstance(func, ast.Call):  # factory(...)(args) — look inside
+        return _terminal_callee(func.func)
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _assign_targets(node: ast.AST) -> List[str]:
+    """Dotted/plain names stored by an assignment target tree."""
+    out: List[str] = []
+    for t in ast.walk(node):
+        if isinstance(t, ast.Name) and isinstance(t.ctx, ast.Store):
+            out.append(t.id)
+        elif isinstance(t, ast.Attribute) and isinstance(t.ctx, ast.Store):
+            dotted = _dotted(t)
+            if dotted:
+                out.append(dotted)
+    return out
+
+
+def _reads_step_output(expr: ast.AST, outputs: Set[str]) -> bool:
+    plain = {o for o in outputs if "." not in o}
+    dotted = {o for o in outputs if "." in o}
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Name) and n.id in plain:
+            return True
+        if isinstance(n, ast.Attribute):
+            d = _dotted(n)
+            if d and any(d == o or d.startswith(o + ".") for o in dotted):
+                return True
+    return False
+
+
+def check_blocking_readback(path: str, tree: ast.Module,
+                            source_lines: Sequence[str]) -> List[Finding]:
+    """Unconditional host readbacks of step outputs inside a train loop.
+
+    A loop qualifies when its body calls a step-advancing function
+    (STEP_ADVANCING_CALLS).  A readback qualifies when it executes on
+    EVERY iteration — i.e. not nested under an ``if`` within the loop
+    (cadence-gated logging is the sanctioned pattern) — and its argument
+    derives from a variable assigned from the step call.  Tests are
+    exempt: convergence tests read the loss back per step on purpose.
+    """
+    parts = path.replace(os.sep, "/").split("/")
+    if "tests" in parts or parts[-1].startswith("test_"):
+        return []
+    findings: List[Finding] = []
+
+    loops = [n for n in ast.walk(tree)
+             if isinstance(n, (ast.For, ast.While, ast.AsyncFor))]
+    for loop in loops:
+        # collect step-output names assigned anywhere in this loop body
+        outputs: Set[str] = set()
+        step_callee = ""
+        for n in ast.walk(loop):
+            if isinstance(n, (ast.Assign, ast.AnnAssign, ast.AugAssign)) \
+                    and n.value is not None:
+                calls = [c for c in ast.walk(n.value)
+                         if isinstance(c, ast.Call)
+                         and _terminal_callee(c.func)
+                         in STEP_ADVANCING_CALLS]
+                if calls:
+                    step_callee = _terminal_callee(calls[0].func)
+                    targets = n.targets if isinstance(n, ast.Assign) \
+                        else [n.target]
+                    for t in targets:
+                        outputs.update(_assign_targets(t))
+        if not outputs:
+            continue
+
+        # walk the loop body tracking conditional nesting; stop at nested
+        # loops' own step calls (they get their own pass) is unnecessary —
+        # an inner loop's unconditional readback is still per-step
+        def visit(node: ast.AST, conditional: bool) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue  # deferred execution: not per-iteration
+                child_cond = conditional or isinstance(
+                    child, (ast.If, ast.IfExp, ast.Try, ast.ExceptHandler))
+                if isinstance(child, ast.Call) and not child_cond:
+                    callee = _terminal_callee(child.func)
+                    if callee in READBACK_CALLS and child.args and \
+                            _reads_step_output(child.args[0], outputs) and \
+                            not _suppressed(source_lines, child.lineno,
+                                            "blocking-readback"):
+                        findings.append(Finding(
+                            "blocking-readback",
+                            f"`{callee}(...)` on a {step_callee}() output "
+                            f"runs UNCONDITIONALLY inside the training "
+                            f"loop — one blocking host sync per step "
+                            f"(a full round trip over the axon tunnel); "
+                            f"gate it on a cadence or read back once per "
+                            f"fused block",
+                            path, child.lineno,
+                            rule="no per-step host readbacks on the "
+                                 "training hot path"))
+                visit(child, child_cond)
+
+        visit(loop, conditional=False)
+    return findings
+
+
 # ----------------------------------------------- control-plane-hygiene
 
 
@@ -443,6 +570,8 @@ def run_paths(paths: Sequence[str],
             findings.extend(check_env_at_trace(rel, tree, lines, key_vars))
         if not checkers or "donated-reuse" in checkers:
             findings.extend(check_donated_reuse(rel, tree, lines))
+        if not checkers or "blocking-readback" in checkers:
+            findings.extend(check_blocking_readback(rel, tree, lines))
         if not checkers or "control-plane-hygiene" in checkers:
             findings.extend(
                 check_control_plane_hygiene(rel, tree, lines))

@@ -305,6 +305,55 @@ class AccelerateResult:
     cache_key: str = ""
     cache_warm: bool = False
     strategy_spec: Optional[list] = None
+    # fused multi-step dispatch (trainer/train_step.py): K the main
+    # `train_step` was built with, plus the lazy factory behind
+    # `fused_train_step(k)` — the trainer auto-tunes K from MEASURED step
+    # time, which only exists after the K=1 step is live, so fused
+    # variants compile on demand, each registering its own cache key
+    fused_steps: int = 1
+    _fused_factory: Any = None   # k -> jitted fused step (None: local_sgd)
+    _fused_key_fn: Any = None    # k -> framework cache key
+    _fused_cache: Dict[int, Callable] = dataclasses.field(
+        default_factory=dict)
+    _cache_dir: Optional[str] = None
+
+    def fused_train_step(self, fused_steps: int) -> Callable:
+        """The K-step fused driver `step(state, batches)` for this build.
+
+        `batches` leaves carry a leading fused axis of size K (stack K
+        per-step batches with `data.elastic_dataset.stack_batches`, place
+        with `place_fused_batch`).  Built lazily and cached per K; each K
+        is a distinct compile and registers its own framework cache key
+        (K changes the HLO — auto/compile_cache.py)."""
+        k = int(fused_steps)
+        if k <= 1:
+            return self.train_step
+        if self._fused_factory is None:
+            raise ValueError(
+                "fused_steps > 1 does not compose with local_sgd — the "
+                "DiLoCo step's outer sync counts dispatches, and a K-step "
+                "fusion would scan across sync boundaries; run unfused "
+                "(fused_steps=1)")
+        fn = self._fused_cache.get(k)
+        if fn is None:
+            fn = self._fused_factory(k)
+            self._fused_cache[k] = fn
+            if self._fused_key_fn is not None:
+                key = self._fused_key_fn(k)
+                note_train_step_served(
+                    self._cache_dir, key,
+                    meta={"mesh": self.strategy.plan.describe(),
+                          "fused_steps": k})
+        return fn
+
+    def place_fused_batch(self, batch):
+        """Shard a fused K-step host batch onto the mesh data axes.
+
+        Leaves carry a leading fused-step axis (and the microbatch scan
+        axis after it when accum_steps > 1) before the global batch dim;
+        both scan axes replicate, the batch dim shards as usual."""
+        batch_axis = 1 + (1 if self.strategy.accum_steps > 1 else 0)
+        return self.place_batch(batch, batch_axis=batch_axis)
 
     def place_batch(self, batch, seq_axis: Optional[int] = None,
                     batch_axis: int = 0):
@@ -388,6 +437,7 @@ def auto_accelerate(
     seq_len: int = 0,
     materialize: bool = True,
     donate: Optional[bool] = None,
+    fused_steps: int = 1,
 ) -> AccelerateResult:
     """Analyse → resolve strategy → build mesh → shard state → compile step.
 
@@ -405,6 +455,12 @@ def auto_accelerate(
     `memory_analysis()` — the scale-proof path (8B+ fit checks without an
     8B machine; parity: reference meta_model_utils.py:1-759 meta-device
     init for 65B-class models).
+
+    `fused_steps=K > 1` builds `result.train_step` as the fused K-step
+    driver (trainer/train_step.py): `step(state, batches)` with a leading
+    fused axis of size K on every batch leaf — one dispatch per K
+    optimizer steps.  Any K (the auto-tuned one included) is also
+    available lazily via `result.fused_train_step(k)` without rebuilding.
     """
     devices = list(devices if devices is not None else jax.devices())
     # Level-1 warm restarts: every build compiles through the persistent
@@ -423,6 +479,14 @@ def auto_accelerate(
     # before model init burns work on a doomed config (strategy-matrix
     # convention; graftlint donation-alias)
     donate = resolve_donation(ctx.extra, donate)
+    if fused_steps > 1 and ctx.extra.get("local_sgd") is not None:
+        # strategy-matrix convention: incompatibilities error at resolve
+        # time, before any parameter init
+        raise ValueError(
+            "fused_steps > 1 does not compose with local_sgd — the DiLoCo "
+            "step's outer sync counts dispatches, and a K-step fusion "
+            "would scan across sync boundaries; run unfused "
+            "(fused_steps=1)")
     overrides = ctx.model_overrides(model)
     if overrides:
         # rebuild the model with the strategy's amp/remat/flash flags
@@ -583,6 +647,7 @@ def auto_accelerate(
                                       opt_host_shardings=opt_host_sh,
                                       opt_device_shardings=opt_dev_sh)
         state_sh = jax.tree.map(lambda x: x.sharding, state)
+        _step_factory = None  # DiLoCo: no fused driver (sync cadence)
         logger.info("local_sgd (DiLoCo): dp=%d groups, sync every %d steps,"
                     " reduce=%s%s%s", ctx.plan.dp, ls_cfg.sync_every,
                     ls_cfg.reduce,
@@ -637,28 +702,38 @@ def auto_accelerate(
         if ctx.plan.pp > 1 and ctx.extra.get("pp_schedule") == "1f1b":
             # manual fwd/bwd interleave replaces autodiff-through-apply
             vg_fn = model.value_and_grad
-        step = make_train_step(
-            loss, optimizer, mesh, planner, accum_steps=ctx.accum_steps,
-            donate=donate,
-            value_and_grad_fn=vg_fn,
-            opt_host_shardings=(state_sh.opt_state if offload_opt
-                                else None),
-            opt_device_shardings=(dev_sh.opt_state if offload_opt
-                                  else None))
+        def _step_factory(k: int):
+            return make_train_step(
+                loss, optimizer, mesh, planner,
+                accum_steps=ctx.accum_steps,
+                donate=donate,
+                value_and_grad_fn=vg_fn,
+                opt_host_shardings=(state_sh.opt_state if offload_opt
+                                    else None),
+                opt_device_shardings=(dev_sh.opt_state if offload_opt
+                                      else None),
+                fused_steps=k)
+        step = _step_factory(fused_steps)
     # framework cache key: everything the trace depends on — mesh shape,
     # the RESOLVED strategy context (not the caller's spelling of it),
-    # the final post-override model config, donation, and the trace-time
-    # env toggles folded in by train_step_cache_key itself
-    cache_key = train_step_cache_key(
-        ctx.plan.sizes(),
-        {"extra": ctx.extra, "amp": ctx.amp, "remat": ctx.remat,
-         "flash_attention": ctx.flash_attention},
-        cfg_for_key,
-        donate=donate,
-        accum_steps=ctx.accum_steps)
+    # the final post-override model config, donation, the fused-step
+    # count, and the trace-time env toggles folded in by
+    # train_step_cache_key itself
+    def _key_for(k: int) -> str:
+        return train_step_cache_key(
+            ctx.plan.sizes(),
+            {"extra": ctx.extra, "amp": ctx.amp, "remat": ctx.remat,
+             "flash_attention": ctx.flash_attention},
+            cfg_for_key,
+            donate=donate,
+            accum_steps=ctx.accum_steps,
+            fused_steps=k)
+
+    cache_key = _key_for(fused_steps)
     cache_warm = note_train_step_served(
         cache_dir, cache_key,
-        meta={"mesh": ctx.plan.describe(), "n_devices": len(devices)})
+        meta={"mesh": ctx.plan.describe(), "n_devices": len(devices),
+              "fused_steps": fused_steps})
     strategy_spec = _jsonable_strategy(strategy, ctx)
     if sample_batch is not None and strategy_spec is not None and \
             cache_dir is not None:
@@ -666,7 +741,7 @@ def auto_accelerate(
         # the model (auto/warm_pool.py; explicit publishing for callers
         # without a sample_batch: ElasticContext.enable_warm_restarts)
         _publish_warm_spec(cache_dir, model, strategy_spec, devices,
-                           sample_batch, ctx.accum_steps)
+                           sample_batch, ctx.accum_steps, fused_steps)
     logger.info("auto_accelerate: mesh=%s params=%s accum=%d "
                 "cache_key=%s%s", ctx.plan.describe(),
                 f"{num_params:,}" if num_params else "?", ctx.accum_steps,
@@ -676,7 +751,9 @@ def auto_accelerate(
         planner=planner, strategy=ctx, loss_fn=loss,
         batch_sharding_fn=planner.batch_sharding, model=model,
         cache_key=cache_key, cache_warm=cache_warm,
-        strategy_spec=strategy_spec)
+        strategy_spec=strategy_spec,
+        fused_steps=fused_steps, _fused_factory=_step_factory,
+        _fused_key_fn=_key_for, _cache_dir=cache_dir)
 
 
 def _jsonable_strategy(strategy: Optional[Sequence],
@@ -713,7 +790,7 @@ def _jsonable_strategy(strategy: Optional[Sequence],
 
 def _publish_warm_spec(cache_dir: str, model, strategy_spec: list,
                        devices: Sequence, sample_batch: Dict,
-                       accum_steps: int) -> None:
+                       accum_steps: int, fused_steps: int = 1) -> None:
     import jax as _jax
 
     from .warm_pool import WarmSpec, model_spec, publish_current_spec
@@ -726,4 +803,4 @@ def _publish_warm_spec(cache_dir: str, model, strategy_spec: list,
     publish_current_spec(cache_dir, WarmSpec(
         n_devices=len(devices), strategy=strategy_spec, model=mspec,
         batch_shape=[int(s) for s in shape], accum_steps=accum_steps,
-        platform=_jax.default_backend()))
+        platform=_jax.default_backend(), fused_steps=max(1, fused_steps)))

@@ -206,12 +206,15 @@ def train_step_cache_key(plan_sizes: Dict[str, int],
                          donate: bool,
                          accum_steps: int,
                          backend: Optional[str] = None,
-                         extra: Optional[Dict] = None) -> str:
+                         extra: Optional[Dict] = None,
+                         fused_steps: int = 1) -> str:
     """Digest of everything the train-step trace depends on.
 
     Same config → same key; changed mesh shape, strategy, model config,
-    donation, or a TRACE_ENV_VARS toggle → different key
-    (tests/test_warm_pool.py pins the invalidation matrix).
+    donation, fused-step count K, or a TRACE_ENV_VARS toggle → different
+    key (tests/test_warm_pool.py pins the invalidation matrix).
+    `fused_steps` changes the HLO (the K-step scan wraps the whole step,
+    trainer/train_step.py) so K=1 and K=8 are distinct compiles.
     """
     import jax
 
@@ -221,6 +224,7 @@ def train_step_cache_key(plan_sizes: Dict[str, int],
         "model": canonicalize(model_config),
         "donate": bool(donate),
         "accum": int(accum_steps),
+        "fused": int(fused_steps),
         "env": {k: os.getenv(k, "") for k in TRACE_ENV_VARS},
         "backend": backend or jax.default_backend(),
         "jax": jax.__version__,

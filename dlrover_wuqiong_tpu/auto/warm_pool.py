@@ -75,6 +75,11 @@ class WarmSpec:
     accum_steps: int = 1
     platform: str = "cpu"   # jax platform the child must compile for
     batch_policy: str = "fixed_global"  # | "per_device"
+    # K of the fused multi-step driver the worker runs (1 = plain step).
+    # K changes the HLO (trainer/train_step.py), so a warm entry compiled
+    # at the wrong K is a cache MISS for the restarted worker — the spec
+    # must carry it.
+    fused_steps: int = 1
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), sort_keys=True)
@@ -456,16 +461,22 @@ def _child_main(spec_path: str) -> int:
                 f"{spec.n_devices}")
         strategy = [tuple(s) if isinstance(s, list) else s
                     for s in spec.strategy]
+        fused = max(1, int(getattr(spec, "fused_steps", 1)))
         res = auto_accelerate(model, optimizer=optax.adamw(3e-4),
                               strategy=strategy, devices=devices,
                               accum_steps=spec.accum_steps,
-                              materialize=False)
+                              materialize=False, fused_steps=fused)
         shape = tuple(spec.batch_shape)
+        batch_axis = 0
         if spec.accum_steps > 1:
             shape = (spec.accum_steps,) + shape
-            bsh = res.batch_sharding_fn(len(shape), None, 1)
-        else:
-            bsh = res.batch_sharding_fn(len(shape), None, 0)
+            batch_axis += 1
+        if fused > 1:
+            # the fused driver scans K pre-staged batches: leading fused
+            # axis before the (optional) microbatch axis
+            shape = (fused,) + shape
+            batch_axis += 1
+        bsh = res.batch_sharding_fn(len(shape), None, batch_axis)
         ab = {"input_ids": jax.ShapeDtypeStruct(shape, jnp.int32,
                                                 sharding=bsh),
               "labels": jax.ShapeDtypeStruct(shape, jnp.int32,
@@ -479,6 +490,7 @@ def _child_main(spec_path: str) -> int:
             "n_devices": spec.n_devices,
             "mesh": res.strategy.plan.describe(),
             "platform": spec.platform,
+            "fused_steps": fused,
             "compile_s": round(time.time() - t0, 2),
             "already_cached": (h1 - h0) > 0 and (m1 - m0) == 0,
             "ready": True,

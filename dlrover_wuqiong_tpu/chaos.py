@@ -11,7 +11,8 @@ it is equally a CI test body (tests/test_chaos.py) and an operator tool:
     python -m dlrover_wuqiong_tpu.chaos pod-kill
     python -m dlrover_wuqiong_tpu.chaos straggler
     python -m dlrover_wuqiong_tpu.chaos network-partition
-    python -m dlrover_wuqiong_tpu.chaos preempt-warm  # re-mesh compile win
+    python -m dlrover_wuqiong_tpu.chaos preempt-warm   # re-mesh compile win
+    python -m dlrover_wuqiong_tpu.chaos preempt-fused  # K-step boundaries
 
 pod-kill drives the REAL stack — `run` CLI → master → agent → worker with
 flash checkpoints — and hard-SIGKILLs the worker process group externally
@@ -311,9 +312,11 @@ from dlrover_wuqiong_tpu.trainer.elastic import init_elastic
 from dlrover_wuqiong_tpu.checkpoint.checkpointer import (
     FlashCheckpointer, StorageType)
 
-(ckpt_dir, marker_dir, total_steps, dt, interval, flash, with_model) = (
+(ckpt_dir, marker_dir, total_steps, dt, interval, flash, with_model,
+ fused) = (
     sys.argv[1], sys.argv[2], int(sys.argv[3]), float(sys.argv[4]),
-    int(sys.argv[5]), sys.argv[6] == "1", sys.argv[7] == "1")
+    int(sys.argv[5]), sys.argv[6] == "1", sys.argv[7] == "1",
+    int(sys.argv[8]))
 ctx = init_elastic()
 restart = ctx.world.restart_count
 timing = {"restart": restart, "compile_s": 0.0, "restore_s": 0.0,
@@ -342,9 +345,16 @@ if with_model:
     bs = max(4, len(jax.devices()))
     data = np.random.default_rng(0).integers(
         0, cfg.vocab_size, (bs, 33)).astype(np.int32)
-    b = res.place_batch({"input_ids": jnp.asarray(data[:, :-1]),
-                         "labels": jnp.asarray(data[:, 1:])})
-    st, m = res.train_step(res.state, b)
+    hb = {"input_ids": data[:, :-1], "labels": data[:, 1:]}
+    if fused > 1:
+        # the re-mesh cost a FUSED worker pays: K changes the HLO, so
+        # this is its own cache entry (auto/compile_cache.py)
+        from dlrover_wuqiong_tpu.data.elastic_dataset import stack_batches
+        fb = res.place_fused_batch(stack_batches([hb] * fused))
+        st, m = res.fused_train_step(fused)(res.state, fb)
+    else:
+        b = res.place_batch(dict(hb))
+        st, m = res.train_step(res.state, b)
     float(m["loss"])  # force the compile + first dispatch
     h1, m1 = counters.snapshot()
     timing.update(compile_s=round(time.time() - t0, 3),
@@ -364,19 +374,29 @@ with open(os.path.join(marker_dir, f"pid_r{restart}"), "w") as f:
     f.write(str(os.getpid()))
 log = open(os.path.join(marker_dir, "steps.log"), "a")
 step = start - 1
-for step in range(start, total_steps):
-    time.sleep(dt)  # the simulated train step
+s = start
+while s < total_steps:
+    # one fused K-step dispatch: the host observes NOTHING until the
+    # boundary — staging, disk saves and step reports all fire there
+    # (fused=1 degenerates to the per-step loop)
+    k_eff = min(fused - s % fused, total_steps - s)
+    time.sleep(dt * k_eff)  # the simulated K-step fusion
+    step = s + k_eff - 1
     sd = {"w": np.full((8, 8), float(step), np.float32),
           "step": np.int64(step)}
     if flash:
-        # stage EVERY step to shm (~free); the agent's save-on-failure
-        # persists the last staged step when the worker is killed
+        # stage every BOUNDARY to shm (~free); the agent's
+        # save-on-failure persists the last staged boundary when the
+        # worker is killed — loss per kill is bounded by K, not interval
         ckpt.save_checkpoint(step, sd, storage_type=StorageType.MEMORY)
-    if step % interval == 0 or step == total_steps - 1:
+    if any((s + i) % interval == 0 for i in range(k_eff)) or \
+        step == total_steps - 1:
         ckpt.save_checkpoint(step, sd, storage_type=StorageType.DISK)
-    log.write(f"{time.time()} {step} {restart}\n")
+    for i in range(k_eff):
+        log.write(f"{time.time()} {s + i} {restart}\n")
     log.flush()
     ctx.report_step(step)
+    s += k_eff
 ok = ckpt.wait_latest_checkpoint(60)
 with open(os.path.join(marker_dir, "done"), "w") as f:
     f.write(f"{ok} {step}")
@@ -387,7 +407,8 @@ def preempt(total_steps: int = 600, dt: float = 0.1,
             ckpt_interval: int = 50, kills: int = 2, seed: int = 0,
             flash: bool = True, target: float = 0.95,
             timeout: float = 420.0, model: bool = False,
-            cache_dir: str = "", compile_cache: bool = True) -> Dict:
+            cache_dir: str = "", compile_cache: bool = True,
+            fused_steps: int = 1) -> Dict:
     """Randomized preemption drill against the goodput north star.
 
     N SIGKILLs land at seeded-random times over the run; goodput is
@@ -410,6 +431,13 @@ def preempt(total_steps: int = 600, dt: float = 0.1,
     `rework_s` (re-executed steps).  `compile_cache=False` runs the
     cold-compile control (DWT_COMPILE_CACHE=0); `cache_dir` pins the
     cache location (fresh dir → first generation cold, restarts warm).
+
+    `fused_steps=K > 1` runs the worker as the fused K-step driver
+    (trainer/train_step.py): the host observes only fusion BOUNDARIES, so
+    shm staging, disk saves and preemption recovery all quantize to K —
+    the drill proves the boundary-only elastic contract still meets the
+    goodput target (loss per kill bounded by K + restart latency, not by
+    the disk interval).
     """
     import random
 
@@ -422,7 +450,7 @@ def preempt(total_steps: int = 600, dt: float = 0.1,
     cli, work, ckpt_dir, marker, job = _launch_standalone(
         "preempt", _PREEMPT_WORKER,
         [total_steps, dt, ckpt_interval, "1" if flash else "0",
-         "1" if model else "0"],
+         "1" if model else "0", max(1, fused_steps)],
         max_restarts=kills + 1, extra_env=extra_env)
 
     # seeded kill schedule: uniform over the productive middle of the run
@@ -480,6 +508,7 @@ def preempt(total_steps: int = 600, dt: float = 0.1,
     report: Dict = {
         "scenario": "preempt", "total_steps": total_steps, "dt": dt,
         "ckpt_interval": ckpt_interval, "flash": flash,
+        "fused_steps": max(1, fused_steps),
         "kills": killed, "cli_rc": cli.returncode,
         "wall_s": round(wall, 1), "ideal_s": round(ideal, 1),
         "executed_steps": executed,
@@ -570,6 +599,21 @@ def preempt_table(total_steps: int = 600, dt: float = 0.1,
                       for r in rows)}
 
 
+def preempt_fused(total_steps: int = 300, dt: float = 0.05,
+                  kills: int = 2, seed: int = 3,
+                  fused_steps: int = 5) -> Dict:
+    """Preemption drill with the fused K-step driver: elastic hooks
+    (shm staging, disk saves, recovery) fire at fusion boundaries ONLY,
+    and the goodput north star must still hold — the boundary
+    quantization loses at most K-1 steps per kill, which flash staging
+    keeps well inside the >=0.95 target at K=5."""
+    r = preempt(total_steps=total_steps, dt=dt, ckpt_interval=50,
+                kills=kills, seed=seed, flash=True, target=0.95,
+                fused_steps=fused_steps)
+    r["scenario"] = "preempt-fused"
+    return r
+
+
 def preempt_warm(total_steps: int = 120, dt: float = 0.05,
                  kills: int = 1, seed: int = 1,
                  timeout: float = 420.0) -> Dict:
@@ -617,7 +661,8 @@ def preempt_warm(total_steps: int = 120, dt: float = 0.05,
 SCENARIOS = {"pod-kill": pod_kill, "straggler": straggler,
              "network-partition": network_partition,
              "preempt": preempt, "preempt-table": preempt_table,
-             "preempt-warm": preempt_warm}
+             "preempt-warm": preempt_warm,
+             "preempt-fused": preempt_fused}
 
 
 def main(argv=None):

@@ -497,8 +497,36 @@ def restore_pytree(template: Any, flat: Dict[str, np.ndarray]) -> Any:
     # removes (93 s measured vs 56 s plain).  On directly-attached hosts
     # the per-transfer overhead is microseconds and packing solves a
     # problem that does not exist — so the simple batched path stays.
-    for name, placed in zip(put_names,
-                            jax.device_put(put_values, put_shardings)):
+    placed_list = list(jax.device_put(put_values, put_shardings))
+    if placed_list and jax.default_backend() == "cpu":
+        # jax 0.4.37 XLA:CPU gap: DONATING a device_put-sourced array into
+        # an executable DESERIALIZED from the persistent compile cache
+        # reads freed/aliased memory (~half of runs — found by the fused-
+        # dispatch boundary-restore test, tests/test_fused_steps.py, which
+        # deterministically hit it on the warm tier-1 cache).  Executable
+        # OUTPUTS are immune, so launder the restored leaves through ONE
+        # jitted identity copy — a single dispatch for the whole state,
+        # nothing per leaf.  pinned_host leaves are skipped: they cannot
+        # ride a plain jit on this backend and are never donated anyway
+        # (optimizer_offload disables donation, CLAUDE.md).
+        import jax.numpy as jnp
+
+        groups: Dict[Any, list] = {}
+        for i, s in enumerate(put_shardings):
+            if getattr(s, "memory_kind", None) == "pinned_host":
+                continue
+            # one jit per device set: leaves restored onto different
+            # device subsets (sharded state + single-device extras)
+            # cannot ride the same computation
+            key = frozenset(getattr(d, "id", 0)
+                            for d in getattr(s, "device_set", ()))
+            groups.setdefault(key, []).append(i)
+        for idx in groups.values():
+            fresh = jax.jit(lambda xs: [jnp.copy(x) for x in xs])(
+                [placed_list[i] for i in idx])
+            for i, arr in zip(idx, fresh):
+                placed_list[i] = arr
+    for name, placed in zip(put_names, placed_list):
         if name in cast_after:
             placed = placed.astype(cast_after[name])
         leaves_by_name[name] = placed

@@ -105,6 +105,101 @@ def measure_h2d_gbps(device=None, size_mb: int = 32,
     return gbps
 
 
+def has_pinned_host_memory() -> bool:
+    """True when the default device can address `pinned_host` memory.
+
+    jax 0.4.37's CPU backend only exposes `unpinned_host`, so the
+    optimizer_offload strategy (moments parked in pinned_host,
+    trainer/train_step.py) cannot even build its shardings there —
+    its tests skip with a version reason instead of failing."""
+    import jax
+
+    try:
+        return any(getattr(m, "kind", "") == "pinned_host"
+                   for m in jax.devices()[0].addressable_memories())
+    except Exception:  # noqa: BLE001 — older jax without memories API
+        return False
+
+
+def has_multiprocess_cpu() -> bool:
+    """True when the CPU backend can run multi-process SPMD.
+
+    jax 0.4.x raises `Multiprocess computations aren't implemented on
+    the CPU backend` from any cross-process computation; the multi-host
+    CPU path arrived with the 0.5+ proxy backend.  Gates the
+    `jax.distributed` end-to-end drills on CPU-only containers."""
+    import jax
+
+    try:
+        major, minor = (int(p) for p in jax.__version__.split(".")[:2])
+    except ValueError:  # pragma: no cover — exotic version string
+        return True
+    return (major, minor) >= (0, 5)
+
+
+def has_jax_shard_map() -> bool:
+    """True when `jax.shard_map` with axis_names support exists
+    (jax >= 0.6).  Pipeline parallelism, local_sgd/DiLoCo and the
+    ring/ulysses context-parallel attention all build on the manual-axes
+    shard_map API; on older jax (this container ships 0.4.37) those
+    features raise RuntimeError at build time and their tests skip with
+    a version reason instead of failing (tests/* skipif gates)."""
+    try:
+        from jax import shard_map  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+_dispatch_overhead_cache: dict = {}
+
+
+def measure_dispatch_overhead_s(iters: int = 30,
+                                force: bool = False) -> float:
+    """Measured fixed cost of ONE jit dispatch on this backend (seconds).
+
+    Chains a scalar increment `iters` times through one jitted call each
+    and syncs ONCE with a host readback at the end (bench.py idiom:
+    `block_until_ready` is a no-op over the axon tunnel), so the number
+    is the per-dispatch pipeline overhead — ~5-8ms over the tunnel,
+    O(100us) on a local CPU backend — not the round-trip latency.  Feeds
+    the fused-step auto-tuner (trainer/train_step.py auto_fused_steps).
+    DWT_DISPATCH_OVERHEAD_S pins/overrides the probe (deterministic
+    tests, known deployments); cached per backend after first measure."""
+    import os
+    import time
+
+    env = os.getenv("DWT_DISPATCH_OVERHEAD_S")
+    if env:
+        try:
+            v = float(env)
+            if v >= 0:
+                return v
+        except ValueError:
+            pass
+    import jax
+    import jax.numpy as jnp
+
+    key = jax.default_backend()
+    if not force and key in _dispatch_overhead_cache:
+        return _dispatch_overhead_cache[key]
+
+    @jax.jit
+    def _bump(x):
+        return x + 1
+
+    x = _bump(jnp.zeros((), jnp.float32))
+    float(x)  # compile + sync
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        x = _bump(x)
+    float(x)
+    overhead = (time.perf_counter() - t0) / iters
+    _dispatch_overhead_cache[key] = overhead
+    return overhead
+
+
 def is_oom_error(exc: BaseException) -> bool:
     """True when `exc` is an accelerator out-of-memory failure.
 

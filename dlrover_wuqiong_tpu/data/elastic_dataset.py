@@ -13,7 +13,10 @@ sharding.  Elasticity comes from (a) the master-backed `ShardingClient`
 (workers pull shards, failed workers' shards are re-dispatched — the dynamic
 path) or (b) the deterministic `ElasticDistributedSampler` (rank-sliced with
 a resumable epoch/step cursor — the static path).  `DevicePrefetcher`
-overlaps host batch prep with device compute.
+overlaps host batch prep with device compute; `FusedBatchStager` builds
+on it for fused K-step dispatch (trainer/train_step.py), staging the
+next K batches as ONE stacked device_put while the current fusion
+executes.
 """
 
 from __future__ import annotations
@@ -181,6 +184,69 @@ class DevicePrefetcher:
                 raise self._err
             raise StopIteration
         return item
+
+
+def stack_batches(batches: Sequence[Any]):
+    """Stack K host batches on a NEW leading fused-step axis.
+
+    The host-side half of fused multi-step dispatch
+    (trainer/train_step.py): the fused driver scans this axis on device,
+    so K per-step batches ride ONE `device_put` and one dispatch instead
+    of K of each."""
+    import jax
+
+    return jax.tree.map(lambda *xs: np.stack(xs), *batches)
+
+
+class FusedBatchStager:
+    """Stage fused K-step blocks onto device while the current fusion runs.
+
+    Builds on `DevicePrefetcher`: a background thread pulls K host batches
+    per block from `batch_at(step)`, stacks them (`stack_batches`), and
+    runs `place_block` (typically `AccelerateResult.place_fused_batch`) so
+    block N+1's host→HBM copy overlaps block N's on-device K-step scan.
+    Yields `(start_step, k_eff, device_block)`.
+
+    `k_eff` honors boundary alignment: the first block is truncated to the
+    next multiple of `fused_steps` (a rollback resume can land anywhere)
+    and the last to `max_steps`, so every trainer hook cadence that K
+    divides fires exactly at a block boundary.
+    """
+
+    def __init__(self, batch_at: Callable[[int], Any],
+                 place_block: Callable[[Any], Any], fused_steps: int,
+                 start_step: int, max_steps: int,
+                 place_single: Optional[Callable[[Any], Any]] = None,
+                 depth: int = 2):
+        """`place_single` places the un-stacked batch of a truncated
+        k_eff=1 alignment/tail block (the K=1 step takes no fused axis);
+        defaults to `place_block`."""
+        if fused_steps < 1:
+            raise ValueError(f"fused_steps must be >= 1, got {fused_steps}")
+        self.fused_steps = fused_steps
+        place_single = place_single or place_block
+
+        def blocks() -> Iterator[Any]:
+            step = start_step
+            while step < max_steps:
+                k_eff = min(fused_steps - step % fused_steps,
+                            max_steps - step)
+                if k_eff == 1:
+                    yield step, 1, batch_at(step)
+                else:
+                    yield step, k_eff, stack_batches(
+                        [batch_at(step + i) for i in range(k_eff)])
+                step += k_eff
+
+        def _place(item):
+            step, k_eff, host = item
+            placed = place_block(host) if k_eff > 1 else place_single(host)
+            return step, k_eff, placed
+
+        self._pf = DevicePrefetcher(blocks(), _place, depth=depth)
+
+    def __iter__(self):
+        return self._pf
 
 
 class ElasticDataLoader:

@@ -75,7 +75,10 @@ def _compiler_params(*semantics, vmem_limit: Optional[int] = None):
         # plus per-head f32 scratch: past the 16MB default scoped limit,
         # well inside v5e's 128MB physical VMEM
         kw["vmem_limit_bytes"] = vmem_limit
-    return pltpu.CompilerParams(dimension_semantics=semantics, **kw)
+    # jax < 0.6 spells it TPUCompilerParams; same fields either way
+    params_cls = getattr(pltpu, "CompilerParams", None) or \
+        getattr(pltpu, "TPUCompilerParams")
+    return params_cls(dimension_semantics=semantics, **kw)
 
 
 def _dot(a, b):

@@ -112,7 +112,8 @@ class ElasticContext:
                                    dataset_size, **kwargs)
 
     def enable_warm_restarts(self, result, global_batch: int,
-                             seq_len: int, model=None):
+                             seq_len: int, model=None,
+                             fused_steps: Optional[int] = None):
         """Publish this world's compile spec and start warming the worlds
         one failure away (auto/warm_pool.py).
 
@@ -147,12 +148,18 @@ class ElasticContext:
                         "warm-pool registry (gpt/llama)")
             return None
         cache_dir = active_cache_dir() or default_cache_dir()
+        if fused_steps is None:
+            # default to the K the result runs with (the trainer's
+            # auto-tuned K when fusion is on) — a warm entry at the wrong
+            # K is a cache miss for the restarted worker
+            fused_steps = getattr(result, "fused_steps", 1)
         spec = WarmSpec(
             n_devices=len(jax.devices()),
             strategy=result.strategy_spec, model=mspec,
             batch_shape=[int(global_batch), int(seq_len)],
             accum_steps=result.strategy.accum_steps,
-            platform=jax.default_backend())
+            platform=jax.default_backend(),
+            fused_steps=max(1, int(fused_steps)))
         publish_current_spec(cache_dir, spec)
         if self._warm_pool is None:
             self._warm_pool = WarmPool(cache_dir)

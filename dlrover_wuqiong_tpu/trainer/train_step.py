@@ -5,6 +5,15 @@ tail — FSDP/TP modules with per-layer NCCL collectives).  TPU redesign: one
 jit'd step over the global mesh; GSPMD inserts all collectives from the
 in/out shardings.  Gradient accumulation (reference ElasticTrainer's fixed
 global batch) is a `lax.scan` over microbatches inside the step.
+
+Fused multi-step dispatch (`fused_steps=K`): a second `lax.scan` level
+wraps the whole step over K pre-staged batches, so ONE dispatch drives K
+optimizer updates and ONE host readback per fusion syncs all K metrics.
+The fixed per-dispatch cost (~5-8ms over the axon tunnel, CLAUDE.md) then
+amortizes to <2% of a fusion instead of dominating small steps —
+`auto_fused_steps` picks K from measured step time vs. measured dispatch
+overhead, clamped so the trainer's hook cadences (checkpoint/logging/eval)
+stay exactly reachable at fusion boundaries.
 """
 
 from __future__ import annotations
@@ -63,6 +72,7 @@ def make_train_step(
     value_and_grad_fn: Optional[Callable] = None,
     opt_host_shardings: Any = None,
     opt_device_shardings: Any = None,
+    fused_steps: int = 1,
 ):
     """Returns jit'd `step(state, batch) -> (state, metrics)`.
 
@@ -73,6 +83,20 @@ def make_train_step(
     `opt_host_shardings`/`opt_device_shardings` (both or neither): the
     optimizer state lives in host memory between steps (optimizer_offload
     strategy) — the step hops it to device for the update and back.
+
+    `fused_steps=K > 1` returns the fused driver `step(state, batches) ->
+    (state, metrics)` instead: `lax.scan` of the SAME per-step math over K
+    pre-staged batches (leaves carry a leading fused axis of size K) inside
+    ONE jit — one dispatch per K optimizer steps instead of K, which
+    amortizes the fixed per-dispatch overhead (~5-8ms over the axon
+    tunnel, CLAUDE.md) that otherwise caps small-step throughput.  Metrics
+    accumulate ON DEVICE in the scan outputs: `metrics["losses"]` /
+    `metrics["grad_norms"]` are per-step arrays of shape (K,) and
+    `metrics["loss"]` / `metrics["grad_norm"]` are the LAST step's values,
+    so one host readback per fusion syncs the whole block — no per-step
+    `float(...)` sync survives on the hot path.  Donation semantics are
+    unchanged: the carried state is donated exactly as in the K=1 case
+    (and still rejected under optimizer_offload below).
     """
 
     def _grads(params, batch):
@@ -113,7 +137,56 @@ def make_train_step(
             "mismatch; pass donate=False (auto_accelerate's donate=None "
             "resolves this automatically)")
     donate_argnums = (0,) if donate else ()
-    return jax.jit(train_step, donate_argnums=donate_argnums)
+    if fused_steps <= 1:
+        return jax.jit(train_step, donate_argnums=donate_argnums)
+
+    def fused_train_step(state: TrainState, batches):
+        def body(st, b):
+            st, m = train_step(st, b)
+            return st, m
+
+        state, stacked = jax.lax.scan(body, state, batches,
+                                      length=fused_steps)
+        metrics = {
+            "loss": stacked["loss"][-1],
+            "grad_norm": stacked["grad_norm"][-1],
+            "losses": stacked["loss"],
+            "grad_norms": stacked["grad_norm"],
+        }
+        return state, metrics
+
+    return jax.jit(fused_train_step, donate_argnums=donate_argnums)
+
+
+def auto_fused_steps(step_time_s: float, overhead_s: Optional[float] = None,
+                     target_overhead: float = 0.02, cap: int = 64,
+                     cadence: int = 0) -> int:
+    """Pick K so the per-dispatch overhead is < `target_overhead` of a
+    K-step fusion: K >= overhead / (target * step_time).
+
+    `cap` bounds staging memory (K batches live on device at once) and the
+    reaction latency of fusion-boundary hooks.  `cadence` (the gcd of the
+    trainer's active step cadences — logging/save/eval/tune) clamps K to
+    its largest divisor so checkpoint cadence stays exactly reachable:
+    hooks fire only at fusion boundaries, and the preempt-table goodput
+    curve (chaos.py) is meaningful only if the chosen ckpt interval is a
+    boundary."""
+    import math
+
+    if overhead_s is None:
+        from ..common.util import measure_dispatch_overhead_s
+
+        overhead_s = measure_dispatch_overhead_s()
+    if step_time_s <= 0:
+        k = cap
+    else:
+        k = math.ceil(overhead_s / (target_overhead * step_time_s))
+    k = max(1, min(k, cap))
+    if cadence > 0:
+        k = min(k, cadence)
+        while cadence % k:
+            k -= 1
+    return k
 
 
 def shard_train_state(state: TrainState, planner: ShardingPlanner

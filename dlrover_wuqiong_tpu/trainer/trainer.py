@@ -9,6 +9,16 @@ step), elastic context (rendezvous world + dynamic sharding when launched
 by the agent), flash checkpoint (auto-resume + save cadence +
 save-on-exit), the step profiler (always-on timing + windowed traces), lr
 schedules, and periodic evaluation.
+
+The hot loop runs the fused K-step driver by default
+(`TrainingArgs.fused_steps=0` auto-tunes K from measured step time vs.
+measured dispatch overhead): one dispatch and one metrics readback per K
+optimizer steps, batches staged K-at-a-time by `FusedBatchStager` while
+the current fusion executes.  Every elastic hook — logging, checkpoint
+saves, shm staging, eval, master config polls, graceful SIGTERM
+preemption, and the rollback resume — fires at fusion boundaries only;
+K is clamped to divide the active cadences so those boundaries land
+exactly where the unfused loop would have fired them.
 """
 
 from __future__ import annotations
@@ -58,6 +68,10 @@ class TrainingArgs:
             raise ValueError(
                 f"unsupported ckpt_wire_dtype {self.ckpt_wire_dtype!r}; "
                 f"use 'bf16' or None")
+        if self.fused_steps < 0:
+            raise ValueError(
+                f"fused_steps must be >= 0 (0 = auto-tune), got "
+                f"{self.fused_steps}")
     profile_trace_dir: str = ""              # jax.profiler window target
     profile_start_step: int = -1
     profile_end_step: int = -1
@@ -66,6 +80,21 @@ class TrainingArgs:
     # every k steps (0 = off); applies dataloader batch size + ckpt cadence
     probe_interval: float = 30.0             # device-queue liveness probe
     # cadence for hang localization (0 = off; active only under the agent)
+    # fused multi-step dispatch (trainer/train_step.py): 0 = auto-tune K
+    # from measured step time vs. measured dispatch overhead (target <2%
+    # overhead, clamped to a divisor of the active hook cadences so the
+    # checkpoint cadence stays exactly reachable); 1 = unfused; K>1 =
+    # explicit.  Elastic hooks (save/eval/logging/tune/preemption) fire
+    # at fusion boundaries only.
+    fused_steps: int = 0
+    # SIGTERM (the agent's preemption signal, agent/elastic_agent.py)
+    # finishes the in-flight fusion, saves, and exits cleanly instead of
+    # dying mid-step
+    graceful_preemption: bool = True
+    # stage the train state to shm (save_to_memory) every N steps — at
+    # fusion boundaries when fused — so the agent's save-on-failure
+    # persists the last boundary; 0 = off
+    flash_stage_steps: int = 0
 
 
 class Trainer:
@@ -216,9 +245,67 @@ class Trainer:
             self._iters[id(source)] = it
             return next(it)
 
+    # --------------------------------------------------- fused dispatch
+
+    def request_stop(self):
+        """Graceful stop at the next fusion boundary (preemption path)."""
+        self._preempted = True
+
+    def _on_sigterm(self, signum, frame):
+        logger.info("SIGTERM: finishing the in-flight fusion, then "
+                    "saving and exiting (graceful preemption)")
+        self._preempted = True
+
+    def _hook_cadence(self) -> int:
+        """gcd of the active step cadences — K must divide it so every
+        hook (logging/save/eval/tune) lands exactly on a fusion boundary,
+        keeping the checkpoint cadence from the preempt-table goodput
+        curve reachable."""
+        import math
+
+        a = self.args
+        cad = 0
+        for c in (a.logging_steps, a.save_steps,
+                  a.eval_steps if self.eval_data is not None else 0,
+                  a.tune_config_steps if self._tune_listener is not None
+                  else 0,
+                  a.flash_stage_steps):
+            if c:
+                cad = math.gcd(cad, int(c))
+        return cad
+
+    def _initial_fused_k(self):
+        """args.fused_steps resolved: 1 (off), K (explicit), or None —
+        auto-tune after measuring the first unfused steps."""
+        a = self.args
+        if a.fused_steps == 1:
+            return 1
+        if getattr(self.res, "_fused_factory", None) is None:
+            # local_sgd: no fused driver.  Auto quietly runs unfused;
+            # an explicit K>1 surfaces the strategy conflict.
+            if a.fused_steps > 1:
+                self.res.fused_train_step(a.fused_steps)  # raises
+            logger.info("fused dispatch unavailable for this strategy; "
+                        "running unfused")
+            return 1
+        if a.fused_steps > 1:
+            return a.fused_steps
+        return None  # auto
+
+    def _autotune_fused_k(self, step_time_s: float) -> int:
+        from .train_step import auto_fused_steps
+
+        k = auto_fused_steps(step_time_s, cadence=self._hook_cadence())
+        if k > 1:
+            logger.info("fused_steps auto-tuned to %d "
+                        "(measured step %.1fms)", k, step_time_s * 1e3)
+        return k
+
     # ---------------------------------------------------------------- train
 
     def train(self) -> Dict[str, float]:
+        import signal as _signal
+
         import jax
 
         a = self.args
@@ -242,63 +329,135 @@ class Trainer:
                 logger.info("resumed from step %d", start_step)
 
         last_loss = float("nan")
+        metrics = None
         t_log = time.time()
+        steps_since_log = 0
+        self._preempted = False
+        prev_sigterm = None
+        if a.graceful_preemption:
+            try:
+                prev_sigterm = _signal.signal(_signal.SIGTERM,
+                                              self._on_sigterm)
+            except ValueError:  # not the main thread: leave the default
+                prev_sigterm = None
+        fused_k = self._initial_fused_k()
+        stager = None
+        step_time_s = 0.0
+        step = start_step
         try:
-            for step in range(start_step, a.max_steps):
+            while step < a.max_steps and not self._preempted:
+                if fused_k is None and step - start_step >= 2:
+                    # two unfused steps measured (the first compiles):
+                    # decide K, then fuse the rest of the run
+                    fused_k = self._autotune_fused_k(step_time_s)
+                if fused_k is not None and fused_k > 1 and stager is None:
+                    from ..data.elastic_dataset import FusedBatchStager
+
+                    stager = iter(FusedBatchStager(
+                        lambda s: dict(self._batch_at(self.train_data, s)),
+                        self.res.place_fused_batch, fused_k,
+                        step, a.max_steps,
+                        place_single=self.res.place_batch))
+                if stager is not None:
+                    s0, k_eff, batch = next(stager)
+                else:
+                    s0, k_eff = step, 1
+                    batch = self.res.place_batch(
+                        dict(self._batch_at(self.train_data, step)))
                 if self._tune_listener is not None and \
-                        step % a.tune_config_steps == 0:
+                        s0 % a.tune_config_steps == 0:
                     tuned = self._tune_listener.poll()
                     if tuned:
                         self._apply_tuned_config(tuned)
-                batch = self.res.place_batch(
-                    dict(self._batch_at(self.train_data, step)))
                 prof_before = self.profiler.last_profile
-                with self.profiler.step(step):
-                    self.state, metrics = self.res.train_step(self.state,
-                                                              batch)
+                with self.profiler.step(s0):
+                    if k_eff > 1:
+                        self.state, metrics = self.res.fused_train_step(
+                            k_eff)(self.state, batch)
+                    else:
+                        t0 = time.perf_counter()
+                        self.state, metrics = self.res.train_step(
+                            self.state, batch)
+                        if fused_k is None:
+                            # auto-tune measurement: sync so the timing is
+                            # the real step, not the async dispatch
+                            float(metrics["loss"])
+                            step_time_s = time.perf_counter() - t0
                 if self.profiler.last_profile is not prof_before:
                     # a trace window just closed: surface slow collectives
                     self.ctx.report_op_profile(
                         self.profiler.last_profile.collective_evidence())
-                if a.logging_steps and (step + 1) % a.logging_steps == 0:
+                step = s0 + k_eff
+                steps_since_log += k_eff
+                # ---- boundary hooks: K divides every active cadence, so
+                # these fire exactly as in the unfused loop ----
+                if a.logging_steps and step % a.logging_steps == 0:
+                    # ONE host readback per fusion syncs the whole block
+                    # (metrics["loss"] is the block's last step)
                     last_loss = float(metrics["loss"])
                     dt = time.time() - t_log
                     t_log = time.time()
                     # re-read the live batch size: the master may retune it
                     tokens_per_step = a.seq_len * getattr(
                         self.train_data, "batch_size", a.global_batch_size)
-                    tps = a.logging_steps * tokens_per_step / max(dt, 1e-9)
-                    logger.info("step %d loss=%.4f tokens/s=%.0f",
-                                step + 1, last_loss, tps)
-                    self.ctx.report_step(step + 1)
-                    self.ctx.report_loss(step + 1, last_loss)
+                    tps = steps_since_log * tokens_per_step / max(dt, 1e-9)
+                    steps_since_log = 0
+                    logger.info("step %d loss=%.4f tokens/s=%.0f", step,
+                                last_loss, tps)
+                    self.ctx.report_step(step)
+                    self.ctx.report_loss(step, last_loss)
                     for cb in self.callbacks:
-                        cb(step + 1, {"loss": last_loss,
-                                      "tokens_per_sec": tps})
-                if a.save_steps and (step + 1) % a.save_steps == 0:
-                    self._save(step + 1)
+                        cb(step, {"loss": last_loss,
+                                  "tokens_per_sec": tps})
+                saved = False
+                if a.save_steps and step % a.save_steps == 0:
+                    self._save(step)
+                    saved = True
+                if a.flash_stage_steps and not saved and \
+                        step % a.flash_stage_steps == 0:
+                    # shm staging (save_to_memory): the agent's
+                    # save-on-failure persists this boundary if the next
+                    # fusion never completes
+                    from ..checkpoint.checkpointer import StorageType
+
+                    self.ckpt.save_checkpoint(
+                        step, self.state, storage_type=StorageType.MEMORY)
                 if a.eval_steps and self.eval_data is not None and \
-                        (step + 1) % a.eval_steps == 0:
+                        step % a.eval_steps == 0:
                     eval_loss = self.evaluate()
-                    logger.info("step %d eval_loss=%.4f", step + 1,
-                                eval_loss)
+                    logger.info("step %d eval_loss=%.4f", step, eval_loss)
+            if self._preempted and step < a.max_steps:
+                logger.info("preempted at fusion boundary %d — saving and "
+                            "exiting", step)
         finally:
+            if prev_sigterm is not None:
+                try:
+                    _signal.signal(_signal.SIGTERM, prev_sigterm)
+                except ValueError:
+                    pass
             if self._prober is not None:
                 self._prober.stop()
             if a.save_on_exit:
-                self._save(int(np.asarray(
-                    jax.tree.leaves(self.state.step)[0])))
+                final = int(np.asarray(
+                    jax.tree.leaves(self.state.step)[0]))
+                if getattr(self, "_last_saved_step", -1) != final:
+                    # don't re-stage a step the cadence save just staged:
+                    # two concurrent saves of one step race on the same
+                    # shard files
+                    self._save(final)
                 self.ckpt.wait_latest_checkpoint(600)
             self.profiler.close()
-        if last_loss != last_loss:  # only short runs never logged
-            last_loss = float(metrics["loss"])
-        return {"final_step": a.max_steps, "final_loss": last_loss}
+        if last_loss != last_loss and metrics is not None:
+            last_loss = float(metrics["loss"])  # only short runs never log
+        return {"final_step": a.max_steps, "final_loss": last_loss,
+                "stopped_at": step}
 
     def _save(self, step: int):
         from ..checkpoint.checkpointer import StorageType
 
         blocked = self.ckpt.save_checkpoint(
             step, self.state, storage_type=StorageType.DISK)
+        self._last_saved_step = step
         logger.info("checkpoint step %d staged (blocked %.3fs)", step,
                     blocked)
 

@@ -45,7 +45,10 @@ def main():
     state = res.state
     for i in range(args.steps):
         state, m = res.train_step(state, batch)
-        print(f"step {i + 1} loss={float(m['loss']):.4f}")
+        if (i + 1) % 5 == 0 or i + 1 == args.steps:
+            # cadence-gated readback: a per-step float() would force one
+            # host sync per dispatch (graftlint blocking-readback)
+            print(f"step {i + 1} loss={float(m['loss']):.4f}")
 
 
 if __name__ == "__main__":

@@ -58,7 +58,10 @@ def main():
     state = res.state
     for step in range(3):
         state, metrics = res.train_step(state, batch)
-        print(f"step {step}: loss={float(metrics['loss']):.4f}")
+    # one readback syncs the whole chained run (steps carry the state;
+    # a per-step float() would sync every dispatch — graftlint
+    # blocking-readback)
+    print(f"final loss={float(metrics['loss']):.4f}")
 
 
 if __name__ == "__main__":

@@ -400,6 +400,113 @@ class TestDonatedReuse:
         assert len(found) == 1 and "`table`" in found[0].message
 
 
+class TestBlockingReadback:
+    def test_unconditional_float_in_train_loop_flagged(self, tmp_path):
+        found = _scan_source(
+            tmp_path, "pkg/examples/loop.py", """\
+            '''Parity: ref.py:1'''
+
+            def run(res, state, batch, n):
+                for _ in range(n):
+                    state, m = res.train_step(state, batch)
+                    loss = float(m["loss"])  # per-step host sync
+                return state
+            """)
+        assert [f.checker for f in found] == ["blocking-readback"]
+        assert "float" in found[0].message
+        assert found[0].line == 6
+
+    def test_np_asarray_on_step_output_flagged(self, tmp_path):
+        found = _scan_source(
+            tmp_path, "pkg/examples/loop.py", """\
+            '''Parity: ref.py:1'''
+            import numpy as np
+
+            def run(res, state, batch, n):
+                for _ in range(n):
+                    state, m = res.train_step(state, batch)
+                    np.asarray(m["grad_norm"])
+                return state
+            """)
+        assert [f.checker for f in found] == ["blocking-readback"]
+
+    def test_fused_factory_call_recognized(self, tmp_path):
+        found = _scan_source(
+            tmp_path, "pkg/examples/loop.py", """\
+            '''Parity: ref.py:1'''
+
+            def run(res, state, batch, n, k):
+                for _ in range(n):
+                    state, m = res.fused_train_step(k)(state, batch)
+                    float(m["loss"])
+                return state
+            """)
+        assert [f.checker for f in found] == ["blocking-readback"]
+        assert "fused_train_step" in found[0].message
+
+    def test_cadence_gated_readback_clean(self, tmp_path):
+        found = _scan_source(
+            tmp_path, "pkg/examples/loop.py", """\
+            '''Parity: ref.py:1'''
+
+            def run(res, state, batch, n, log_every):
+                for i in range(n):
+                    state, m = res.train_step(state, batch)
+                    if (i + 1) % log_every == 0:
+                        print(float(m["loss"]))  # throttled: fine
+                return state
+            """)
+        assert found == []
+
+    def test_readback_after_loop_clean(self, tmp_path):
+        found = _scan_source(
+            tmp_path, "pkg/examples/loop.py", """\
+            '''Parity: ref.py:1'''
+
+            def run(res, state, batch, n):
+                for _ in range(n):
+                    state, m = res.train_step(state, batch)
+                return float(m["loss"])  # one sync for the whole chain
+            """)
+        assert found == []
+
+    def test_non_step_value_readback_clean(self, tmp_path):
+        found = _scan_source(
+            tmp_path, "pkg/examples/loop.py", """\
+            '''Parity: ref.py:1'''
+
+            def run(res, state, batch, lrs):
+                for lr in lrs:
+                    state, m = res.train_step(state, batch)
+                    rate = float(lr)  # host value, not a step output
+                return state
+            """)
+        assert found == []
+
+    def test_tests_dir_exempt(self, tmp_path):
+        found = _scan_source(
+            tmp_path, "tests/test_loop.py", """\
+            def test_converges(res, state, batch):
+                for _ in range(4):
+                    state, m = res.train_step(state, batch)
+                    assert float(m["loss"]) < 10  # convergence test: fine
+            """)
+        assert found == []
+
+    def test_pragma_suppression(self, tmp_path):
+        found = _scan_source(
+            tmp_path, "pkg/examples/loop.py", """\
+            '''Parity: ref.py:1'''
+
+            def run(res, state, batch, n):
+                for _ in range(n):
+                    state, m = res.train_step(state, batch)
+                    float(m["loss"])  # graftlint: disable=blocking-readback
+                return state
+            """)
+        assert found == []
+
+
 class TestControlPlaneHygiene:
     def test_pickle_on_frame_path_flagged(self, tmp_path):
         found = _scan_source(

@@ -13,6 +13,8 @@ import sys
 
 import pytest
 
+from version_gates import requires_multiprocess_cpu
+
 WORKER_SCRIPT = r"""
 import os, sys, time
 import numpy as np
@@ -204,6 +206,7 @@ def _base_env(tmp_path, job):
     return env
 
 
+@requires_multiprocess_cpu
 def test_jax_world_crash_restart_resume(tmp_path):
     """Real-mesh elasticity: 2 hosts x 2 virtual devices, fsdp=4 sharded
     TrainState; rank-0 worker crashes after the step-3 commit; both agents
@@ -255,6 +258,7 @@ def test_jax_world_crash_restart_resume(tmp_path):
                 a.kill()
 
 
+@requires_multiprocess_cpu
 def test_jax_world_scale_up(tmp_path):
     """Membership change: a world of 1 node is joined by a second node;
     the running agent restarts its worker into the 2-node world
@@ -308,6 +312,7 @@ def test_jax_world_scale_up(tmp_path):
                 a.kill()
 
 
+@requires_multiprocess_cpu
 def test_jax_world_slice_loss(tmp_path):
     """Multi-slice failure domain (SURVEY §2.5 DCN row; reference node
     groups dist_job_manager.py:88): a whole node group — agent AND its

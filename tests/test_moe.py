@@ -10,6 +10,8 @@ import numpy as np
 import optax
 import pytest
 
+from version_gates import shard_index_set
+
 from dlrover_wuqiong_tpu.auto.accelerate import auto_accelerate
 from dlrover_wuqiong_tpu.models.gpt import GPT, GPTConfig
 from dlrover_wuqiong_tpu.models.moe import MoEConfig, MoEMLP, top_k_gating
@@ -102,7 +104,7 @@ class TestMoETraining:
             strategy=[("expert_parallel", {"size": 4}), ("fsdp", {})])
         w = res.state.params["h_0"]["moe_mlp"]["experts_w_in"]
         # 4 experts over ep=4 (x fsdp=2): expert dim must be split
-        idx = {s.index[0] for s in w.addressable_shards}
+        idx = {t[0] for t in shard_index_set(w)}
         assert len(idx) == 4
 
     def test_moe_matches_dense_param_count_scaling(self):

@@ -71,6 +71,12 @@ class TestCacheKeyInvalidation:
     def test_accum_changes_key(self):
         assert _key() != _key(accum_steps=4)
 
+    def test_fused_steps_changes_key(self):
+        # the K-step scan wraps the whole step (trainer/train_step.py):
+        # K=1 and K=8 are different HLO, so different compiles
+        assert _key() != _key(fused_steps=8)
+        assert _key(fused_steps=8) == _key(fused_steps=8)
+
     def test_trace_env_changes_key(self, monkeypatch):
         cold = _key()
         monkeypatch.setenv("DWT_FA_NO_FUSED", "1")
@@ -184,6 +190,15 @@ class TestWarmSpecs:
         assert WarmSpec.from_json(spec.to_json()) == spec
         assert spec.spec_key() == WarmSpec.from_json(
             spec.to_json()).spec_key()
+
+    def test_fused_steps_rides_spec_and_degradation(self):
+        # K changes the HLO: a degraded-world warm compile at the wrong
+        # K would be a cache miss for the restarted fused worker
+        spec = dataclasses.replace(self._spec(8), fused_steps=4)
+        assert WarmSpec.from_json(spec.to_json()).fused_steps == 4
+        assert spec.spec_key() != self._spec(8).spec_key()
+        out = degraded_specs(spec, num_nodes=2, devices_per_node=4)
+        assert out and out[0].fused_steps == 4
 
 
 def _fake_pool_entry(cache_dir, n_devices, key="k"):

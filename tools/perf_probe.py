@@ -5,8 +5,10 @@ work targets the measured-largest bucket instead of guesses.  Sync follows
 the bench.py rules (host readback; chain iterations on carried values —
 `block_until_ready` is a no-op over the tunnel).
 
-Usage: python tools/perf_probe.py [attn|attn_sweep|head|model|opt|step|lib] ...
-(no args = step/attn/head/model/opt).  One JSON line per probe.
+Usage: python tools/perf_probe.py [attn|attn_sweep|head|model|opt|step|lib|
+dispatch] ...  (no args = step/attn/head/model/opt).  One JSON line per
+probe.  `dispatch` measures the fused-vs-unfused dispatch-overhead win of
+the K-step driver (trainer/train_step.py) in THIS environment.
 """
 
 from __future__ import annotations
@@ -305,6 +307,75 @@ def probe_step():
     _emit("full_step", t)
 
 
+def probe_dispatch(k: int = 8, steps: int = 32):
+    """Fused-vs-unfused dispatch overhead on the real train step.
+
+    Drives the SAME compiled step once per dispatch (chained on state, one
+    final readback) and as one K-step fused scan per dispatch
+    (trainer/train_step.py), on one chip.  The per-step delta is the
+    amortizable dispatch tax of THIS environment — ~5-8ms over the axon
+    tunnel, O(0.1ms) locally — and `auto_k` is what the trainer's
+    auto-tuner would pick here (target <2% overhead)."""
+    import dataclasses
+
+    import numpy as np
+    import optax
+
+    from dlrover_wuqiong_tpu.auto.accelerate import auto_accelerate
+    from dlrover_wuqiong_tpu.common.util import measure_dispatch_overhead_s
+    from dlrover_wuqiong_tpu.data.elastic_dataset import stack_batches
+    from dlrover_wuqiong_tpu.models.gpt import GPT, GPTConfig
+    from dlrover_wuqiong_tpu.trainer.train_step import auto_fused_steps
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = dataclasses.replace(GPTConfig.gpt2(), remat=False)
+        bsz = B
+    else:  # runnable anywhere: the CPU regime is dispatch-BOUND at nano
+        cfg = dataclasses.replace(GPTConfig.nano(), use_flash_attention=False,
+                                  remat=False)
+        bsz = 8
+    seq = cfg.block_size
+    res = auto_accelerate(GPT(cfg), optimizer=optax.adamw(3e-4),
+                          devices=jax.devices()[:1], strategy=[("fsdp", {})])
+    x = np.random.default_rng(0).integers(0, cfg.vocab_size,
+                                          (bsz, seq + 1), dtype=np.int32)
+    hb = {"input_ids": x[:, :-1], "labels": x[:, 1:]}
+    b = res.place_batch(dict(hb))
+
+    st = jax.tree.map(jnp.copy, res.state)
+    st, m = res.train_step(st, b)
+    _sync(m["loss"])  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        st, m = res.train_step(st, b)
+    _sync(m["loss"])  # steps chain on state; one readback syncs them all
+    t_unfused = (time.perf_counter() - t0) / steps
+
+    fused = res.fused_train_step(k)
+    fb = res.place_fused_batch(stack_batches([hb] * k))
+    st, m = fused(st, fb)
+    _sync(m["loss"])  # compile + warm
+    blocks = max(2, steps // k)
+    t0 = time.perf_counter()
+    for _ in range(blocks):
+        st, m = fused(st, fb)
+    _sync(m["loss"])  # one readback per K-step fusion
+    t_fused = (time.perf_counter() - t0) / (blocks * k)
+
+    overhead = measure_dispatch_overhead_s()
+    # the STEP's own amortizable overhead, backed out of the measured
+    # fused-vs-unfused delta (a K-fusion removes (K-1)/K of it) — the
+    # scalar probe underestimates it badly for a many-leaf state
+    step_overhead = max((t_unfused - t_fused) * k / (k - 1), 0.0)
+    _emit("dispatch_fused_vs_unfused", t_unfused, k=k,
+          fused_ms=round(t_fused * 1e3, 3),
+          saved_ms_per_step=round((t_unfused - t_fused) * 1e3, 3),
+          scalar_dispatch_overhead_ms=round(overhead * 1e3, 3),
+          step_dispatch_overhead_ms=round(step_overhead * 1e3, 3),
+          auto_k=auto_fused_steps(t_fused, overhead_s=step_overhead))
+
+
 def probe_splash():
     """jax splash-attention (newer vmapped MQA-style kernel) — causal."""
     try:
@@ -395,7 +466,7 @@ ALL = {"attn": probe_attn, "attn_sweep": probe_attn_sweep, "lib": probe_lib,
        "remat": probe_remat,
        "splash": probe_splash, "dots": probe_dots,
        "head": probe_head, "model": probe_model, "opt": probe_opt,
-       "step": probe_step}
+       "step": probe_step, "dispatch": probe_dispatch}
 
 if __name__ == "__main__":
     names = sys.argv[1:] or ["step", "attn", "head", "model", "opt"]
