@@ -53,36 +53,35 @@ def _init_backend_with_retry(attempts: int = 3, base_delay_s: float = 5.0,
     stays the single JSON line.  EVERY backend touch goes through here
     (`probe` defaults to jax.devices; main's backend-name query passes
     jax.default_backend) so no call path can die with a raw traceback
-    before the JSON contract is emitted."""
-    probe = probe if probe is not None else jax.devices
-    last = None
-    for attempt in range(attempts):
-        try:
-            out = probe()
-            if attempt:
-                print(json.dumps({"backend_init_recovered_attempt":
-                                  attempt + 1}), file=sys.stderr)
-            return out
-        except Exception as e:  # noqa: BLE001 — backend init has no
-            # stable exception type across plugins (RuntimeError,
-            # XlaRuntimeError, grpc errors through the tunnel)
-            last = e
-            if attempt == attempts - 1:
-                break
-            delay = base_delay_s * (2 ** attempt)
-            print(json.dumps({"backend_init_retry": attempt + 1,
-                              "sleep_s": delay,
-                              "error": repr(e)[:300]}), file=sys.stderr)
-            # drop the failed client so the retry re-dials instead of
-            # returning the cached dead backend
-            try:
-                import jax.extend.backend as _xb
+    before the JSON contract is emitted.  The loop itself is the repo's
+    shared `retry_call` (common/util.py) — one retry policy everywhere;
+    this wrapper only supplies the backend-specific teardown."""
+    from dlrover_wuqiong_tpu.common.util import retry_call
 
-                _xb.clear_backends()
-            except Exception:  # noqa: BLE001 — best-effort teardown
-                pass
-            time.sleep(delay)
-    raise last
+    probe = probe if probe is not None else jax.devices
+    used = {"retries": 0}
+
+    def on_retry(n, exc, delay):
+        used["retries"] = n
+        print(json.dumps({"backend_init_retry": n, "sleep_s": round(delay, 2),
+                          "error": repr(exc)[:300]}), file=sys.stderr)
+        # drop the failed client so the retry re-dials instead of
+        # returning the cached dead backend
+        try:
+            import jax.extend.backend as _xb
+
+            _xb.clear_backends()
+        except Exception:  # noqa: BLE001 — best-effort teardown
+            pass
+
+    # retry_on=Exception: backend init has no stable exception type across
+    # plugins (RuntimeError, XlaRuntimeError, grpc errors over the tunnel)
+    out = retry_call(probe, attempts=attempts, base_delay_s=base_delay_s,
+                     max_delay_s=60.0, jitter=0.0, on_retry=on_retry)
+    if used["retries"]:
+        print(json.dumps({"backend_init_recovered_attempt":
+                          used["retries"] + 1}), file=sys.stderr)
+    return out
 
 
 def measure_matmul_ceiling(n: int = 8192, iters: int = 20) -> float:

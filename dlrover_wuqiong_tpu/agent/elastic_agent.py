@@ -192,11 +192,15 @@ class ElasticAgent:
                              my_addr.encode())
         peers = {}
         keys = [f"replica/{rdzv}/{r}" for r in range(outcome.num_processes)]
-        if self.mc.kv_store_wait(keys, timeout=60.0):
+        try:
+            self.mc.kv_store_wait(keys, timeout=60.0)
             vals = self.mc.kv_store_multi_get(keys) or []
             for r, v in enumerate(vals):
                 if v:
                     peers[r] = v.decode() if isinstance(v, bytes) else v
+        except TimeoutError as e:
+            # replication is best-effort: run with the peers that showed up
+            logger.warning("replica peer rendezvous incomplete: %s", e)
         self._replica_manager = CkptReplicaManager(
             rank=outcome.process_id, peers=peers, job_name=job,
             replica_count=replicas)

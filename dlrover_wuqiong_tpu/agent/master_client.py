@@ -3,18 +3,43 @@
 Parity: reference `dlrover/python/elastic_agent/master_client.py` (MasterClient
 :50, get_task :133, join_rendezvous, report_heart_beat :230) and the torch-Store
 client `master_kv_store.py` — here the KV store seeds jax.distributed bootstrap.
+
+Master fault tolerance (this PR's redesign beyond the reference, whose client
+dies with the master after 3 gRPC retries):
+
+- **three verb classes**: CRITICAL verbs (task fetch/results, rendezvous, kv,
+  registration) retry with backoff up to the outage grace deadline
+  (global_context.master_outage_grace_s) — a master restart is invisible
+  below that; BUFFERED fire-and-forget verbs (heartbeats, step/metric/event
+  reports) never block training: on an unreachable master they land in a
+  bounded in-memory queue that drains after reconnect, so elastic hooks at
+  fusion boundaries keep their latency contract through an outage; POLLING
+  verbs (num_nodes_waiting) fail fast and let their caller's own cadence
+  retry.
+- **idempotency keys** ride on report_task_result / kv_store_add /
+  join_rendezvous: a retry that crosses a master restart replays the
+  journaled response instead of re-applying (master/servicer.py).
+- **fencing epoch**: every response carries the master's epoch
+  (common/comm.py); on a bump this client re-registers the node and
+  re-syncs recently acked task results (idempotent — the journaled ones
+  answer from the idem cache) before trusting the new world.
 """
 
 from __future__ import annotations
 
+import os
 import threading
 import time
+import uuid
+from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 from ..common import messages as msg
-from ..common.comm import RpcClient
+from ..common.comm import MasterUnreachableError, RpcClient
 from ..common.constants import RendezvousName
+from ..common.global_context import get_context
 from ..common.log import get_logger
+from ..common.util import retry_call
 
 logger = get_logger("master_client")
 
@@ -23,12 +48,36 @@ class MasterClient:
     _instance = None
     _lock = threading.Lock()
 
+    #: bounded degraded-mode buffer (fire-and-forget frames per client)
+    BUFFER_CAP = 512
+    #: acked task results kept for epoch-bump re-sync
+    RESYNC_CAP = 64
+
     def __init__(self, master_addr: str, node_id: int,
-                 node_type: str = "worker"):
+                 node_type: str = "worker",
+                 outage_grace_s: Optional[float] = None):
         self._client = RpcClient(master_addr, node_id, node_type)
+        self._client.on_epoch_change = self._on_epoch_change
         self.master_addr = master_addr
         self.node_id = node_id
         self.node_type = node_type
+        self._outage_grace_s = (
+            outage_grace_s if outage_grace_s is not None
+            else get_context().master_outage_grace_s)
+        # degraded mode: bounded buffer of (verb, message) frames
+        self._buffer: deque = deque()
+        self._buffer_lock = threading.Lock()
+        self._idem_prefix = f"{node_id}:{os.getpid()}:{uuid.uuid4().hex[:8]}"
+        self._idem_seq = 0
+        # epoch-bump resync state
+        self._registration: Optional[msg.NodeMeta] = None
+        self._recent_results: deque = deque(maxlen=self.RESYNC_CAP)
+        # stats (chaos drills assert on these)
+        self._buffered_total = 0
+        self._flushed_total = 0
+        self._dropped_total = 0
+        self._reregistrations = 0
+        self.epochs_seen: List[int] = []
 
     @classmethod
     def singleton(cls, master_addr: Optional[str] = None,
@@ -50,27 +99,143 @@ class MasterClient:
     def close(self):
         self._client.close()
 
+    # ------------------------------------------------------------ retry core
+
+    @property
+    def epoch(self) -> Optional[int]:
+        """Last master fencing epoch observed on this client."""
+        return self._client.epoch
+
+    def _next_idem(self) -> str:
+        self._idem_seq += 1
+        return f"{self._idem_prefix}:{self._idem_seq}"
+
+    def _call_critical(self, verb: str, payload, idem: Optional[str] = None):
+        """Blocking control-plane verb: ride a master outage with backoff
+        up to the grace deadline, then raise MasterUnreachableError."""
+        resp = self._client._call(  # noqa: SLF001 — typed facade over _call
+            verb, payload, idem=idem, deadline_s=self._outage_grace_s)
+        self._maybe_flush()
+        return resp
+
+    def _call_buffered(self, payload, default):
+        """Fire-and-forget verb: never blocks training on a dead master —
+        a short retry, then the frame parks in the bounded buffer (oldest
+        dropped) and `default` is returned; the buffer drains on the next
+        successful call (reconnect or new master)."""
+        try:
+            resp = self._client._call(  # noqa: SLF001
+                "report", payload, attempts=2)
+        except MasterUnreachableError:
+            with self._buffer_lock:
+                if len(self._buffer) >= self.BUFFER_CAP:
+                    self._buffer.popleft()
+                    self._dropped_total += 1
+                self._buffer.append(payload)
+                self._buffered_total += 1
+            return default
+        self._maybe_flush()
+        return resp
+
+    def _call_polling(self, verb: str, payload):
+        """Advisory verb on a caller-owned cadence: fail fast (the caller's
+        next poll is the retry)."""
+        resp = self._client._call(verb, payload, attempts=2)  # noqa: SLF001
+        self._maybe_flush()
+        return resp
+
+    def _maybe_flush(self):
+        """Drain the degraded-mode buffer after a successful call."""
+        if not self._buffer:
+            return
+        while True:
+            with self._buffer_lock:
+                if not self._buffer:
+                    return
+                payload = self._buffer.popleft()
+            try:
+                self._client._call("report", payload,  # noqa: SLF001
+                                   attempts=1)
+                self._flushed_total += 1
+            except MasterUnreachableError:
+                with self._buffer_lock:
+                    self._buffer.appendleft(payload)
+                return
+            except Exception:  # noqa: BLE001 — a frame the new master
+                # rejects (stale semantics) is dropped, not retried forever
+                logger.warning("degraded-buffer frame rejected on flush",
+                               exc_info=True)
+                self._flushed_total += 1
+
+    def _on_epoch_change(self, old: int, new: int):
+        """A DIFFERENT master answered: re-register, re-sync in-flight
+        task results (idempotent via their original keys), drain buffers.
+
+        Fired by the RpcClient exactly once per bump, outside its socket
+        lock (common/comm.py)."""
+        self.epochs_seen.append(new)
+        logger.warning("master epoch changed %d -> %d — re-registering "
+                       "and re-syncing in-flight state", old, new)
+        try:
+            if self._registration is not None:
+                self._client._call("report", self._registration,  # noqa: SLF001
+                                   attempts=2)
+            for dataset_name, task_id, err, idem in list(
+                    self._recent_results):
+                self._client._call(  # noqa: SLF001
+                    "report",
+                    msg.TaskResult(dataset_name=dataset_name,
+                                   task_id=task_id, err_message=err),
+                    idem=idem, attempts=2)
+            self._reregistrations += 1
+        except MasterUnreachableError:
+            logger.warning("re-sync with epoch-%d master interrupted — "
+                           "the next successful verb retries", new)
+        self._maybe_flush()
+
+    def degraded_stats(self) -> Dict:
+        """Counters for drills/tests: buffer totals + epoch resync state."""
+        with self._buffer_lock:
+            pending = len(self._buffer)
+        return {"buffered_total": self._buffered_total,
+                "flushed_total": self._flushed_total,
+                "dropped_total": self._dropped_total,
+                "pending": pending,
+                "reregistrations": self._reregistrations,
+                "epochs_seen": list(self.epochs_seen),
+                "epoch": self.epoch}
+
     # ------------------------------------------------------------- dataset
 
     def report_dataset_shard_params(self, **kwargs):
-        return self._client.report(msg.DatasetShardParams(**kwargs))
+        return self._call_critical("report", msg.DatasetShardParams(**kwargs))
 
     def get_task(self, dataset_name: str) -> msg.Task:
-        return self._client.get(msg.TaskRequest(dataset_name=dataset_name))
+        # idem key per REQUEST (each poll is a distinct dispatch decision);
+        # a retry of this one request across a master restart replays the
+        # journaled Task instead of double-dispatching
+        return self._call_critical(
+            "get", msg.TaskRequest(dataset_name=dataset_name),
+            idem=self._next_idem())
 
     def report_task_result(self, dataset_name: str, task_id: int,
                            err_message: str = ""):
-        return self._client.report(msg.TaskResult(
+        idem = self._next_idem()
+        self._recent_results.append((dataset_name, task_id, err_message,
+                                     idem))
+        return self._call_critical("report", msg.TaskResult(
             dataset_name=dataset_name, task_id=task_id,
-            err_message=err_message))
+            err_message=err_message), idem=idem)
 
     def get_shard_checkpoint(self, dataset_name: str) -> str:
-        resp = self._client.get(
-            msg.ShardCheckpointRequest(dataset_name=dataset_name))
+        resp = self._call_critical("get",
+                                   msg.ShardCheckpointRequest(
+                                       dataset_name=dataset_name))
         return resp.content
 
     def report_shard_checkpoint(self, content: str):
-        return self._client.report(msg.ShardCheckpoint(content=content))
+        return self._call_critical("report",
+                                   msg.ShardCheckpoint(content=content))
 
     # ------------------------------------------------------------- rendezvous
 
@@ -78,38 +243,37 @@ class MasterClient:
                         rdzv_name: str = RendezvousName.ELASTIC_TRAINING,
                         node_ip: str = "127.0.0.1",
                         free_port: int = 0) -> int:
-        import os
-
-        resp = self._client.report(msg.JoinRendezvousRequest(
+        resp = self._call_critical("report", msg.JoinRendezvousRequest(
             node_id=self.node_id, node_rank=node_rank,
             local_world_size=local_world_size, rdzv_name=rdzv_name,
             node_ip=node_ip, free_port=free_port,
-            slice_id=os.getenv("DWT_SLICE_ID", "")))
+            slice_id=os.getenv("DWT_SLICE_ID", "")),
+            idem=self._next_idem())
         return resp.rdzv_round
 
     def get_comm_world(
         self, rdzv_name: str = RendezvousName.ELASTIC_TRAINING
     ) -> msg.RendezvousState:
-        return self._client.get(msg.CommWorldRequest(
+        return self._call_critical("get", msg.CommWorldRequest(
             node_id=self.node_id, rdzv_name=rdzv_name))
 
     def num_nodes_waiting(
         self, rdzv_name: str = RendezvousName.ELASTIC_TRAINING
     ) -> int:
-        resp = self._client.get(msg.WaitingNodeNumRequest(
+        resp = self._call_polling("get", msg.WaitingNodeNumRequest(
             node_id=self.node_id, rdzv_name=rdzv_name))
         return resp.waiting_num
 
     def network_check_success(self) -> Tuple[bool, str]:
-        resp = self._client.get(msg.NetworkReadyRequest())
+        resp = self._call_critical("get", msg.NetworkReadyRequest())
         return resp.success, resp.reason
 
     def report_network_check_result(self, normal: bool, elapsed: float):
-        return self._client.report(msg.NetworkCheckResult(
+        return self._call_critical("report", msg.NetworkCheckResult(
             node_id=self.node_id, normal=normal, elapsed_time=elapsed))
 
     def get_stragglers(self) -> List[int]:
-        resp = self._client.get(msg.StragglerExistRequest())
+        resp = self._call_polling("get", msg.StragglerExistRequest())
         return resp.nodes
 
     # ------------------------------------------------------------- lifecycle
@@ -117,78 +281,123 @@ class MasterClient:
     def register_node(self, node_rank: int, addr: str = "",
                       accelerator_type: str = "tpu",
                       accelerator_num: int = 0):
-        return self._client.report(msg.NodeMeta(
+        meta = msg.NodeMeta(
             node_type=self.node_type, node_id=self.node_id,
             node_rank=node_rank, addr=addr,
             accelerator_type=accelerator_type,
-            accelerator_num=accelerator_num))
+            accelerator_num=accelerator_num)
+        self._registration = meta  # replayed on every epoch bump
+        return self._call_critical("report", meta)
 
     def report_heart_beat(self, global_step: int = 0) -> str:
         return self.report_heart_beat_full(global_step).action
 
     def report_heart_beat_full(self, global_step: int = 0
                                ) -> msg.HeartbeatResponse:
-        """Full response — carries rollback_before_step for spike rollbacks."""
-        return self._client.report(msg.HeartBeat(
-            node_id=self.node_id, timestamp=time.time(),
-            global_step=global_step))
+        """Full response — carries rollback_before_step for spike rollbacks.
+
+        Degraded mode: on an unreachable master the beat buffers and a
+        no-action response returns — training never blocks on heartbeats."""
+        return self._call_buffered(
+            msg.HeartBeat(node_id=self.node_id, timestamp=time.time(),
+                          global_step=global_step),
+            default=msg.HeartbeatResponse())
 
     def report_failure(self, error_data: str, restart_count: int = 0,
                        level: str = "process"):
-        return self._client.report(msg.NodeFailure(
+        return self._call_critical("report", msg.NodeFailure(
             node_id=self.node_id, restart_count=restart_count,
             error_data=error_data, level=level))
 
     def report_global_step(self, step: int,
                            elapsed_time_per_step: float = 0.0):
-        return self._client.report(msg.GlobalStep(
-            step=step, timestamp=time.time(),
-            elapsed_time_per_step=elapsed_time_per_step))
+        return self._call_buffered(
+            msg.GlobalStep(step=step, timestamp=time.time(),
+                           elapsed_time_per_step=elapsed_time_per_step),
+            default=msg.OkResponse())
 
     def report_node_event(self, event_type: str, message: str = "",
                           level: str = "info"):
-        return self._client.report(msg.NodeEventReport(
-            node_id=self.node_id, node_type=self.node_type,
-            event_type=event_type, message=message, level=level))
+        return self._call_buffered(
+            msg.NodeEventReport(node_id=self.node_id,
+                                node_type=self.node_type,
+                                event_type=event_type, message=message,
+                                level=level),
+            default=msg.OkResponse())
 
     def report_custom_metric(self, data):
         """Push {metric_name: value} to the master; dwt_* names land in the
         master's exported metric registry."""
-        return self._client.report(msg.CustomMetric(data=dict(data)))
+        return self._call_buffered(msg.CustomMetric(data=dict(data)),
+                                   default=msg.OkResponse())
 
     def report_diagnosis(self, payload_type: str,
                          content: str) -> msg.DiagnosisAction:
-        return self._client.report(msg.DiagnosisReport(
+        return self._call_buffered(msg.DiagnosisReport(
             node_id=self.node_id, payload_type=payload_type,
-            content=content, timestamp=time.time()))
+            content=content, timestamp=time.time()),
+            default=msg.DiagnosisAction())
 
     def get_paral_config(self) -> msg.ParallelConfig:
-        return self._client.get(
-            msg.ParallelConfigRequest(node_id=self.node_id))
+        # advisory poll on the tuner's own cadence — fail fast, next poll
+        # is the retry (a 120s-deadline wait here would pin the tuner
+        # thread through a whole outage for a config that barely changes)
+        return self._call_polling("get",
+                                  msg.ParallelConfigRequest(
+                                      node_id=self.node_id))
 
     # ------------------------------------------------------------- kv store
 
     def kv_store_set(self, key: str, value: bytes):
-        return self._client.report(msg.KVStoreSetRequest(key=key,
+        return self._call_critical("report",
+                                   msg.KVStoreSetRequest(key=key,
                                                          value=value))
 
     def kv_store_get(self, key: str) -> Optional[bytes]:
-        resp = self._client.get(msg.KVStoreGetRequest(key=key))
+        resp = self._call_critical("get", msg.KVStoreGetRequest(key=key))
         return resp.value if resp.found else None
 
     def kv_store_multi_get(self, keys: List[str]) -> Optional[List[bytes]]:
-        resp = self._client.get(msg.KVStoreMultiGetRequest(keys=keys))
+        resp = self._call_critical("get",
+                                   msg.KVStoreMultiGetRequest(keys=keys))
         return resp.values if resp.found else None
 
     def kv_store_add(self, key: str, amount: int = 1) -> int:
-        resp = self._client.get(msg.KVStoreAddRequest(key=key, amount=amount))
+        resp = self._call_critical("get",
+                                   msg.KVStoreAddRequest(key=key,
+                                                         amount=amount),
+                                   idem=self._next_idem())
         return resp.num
+
+    class _KVNotReady(Exception):
+        pass
 
     def kv_store_wait(self, keys: List[str], timeout: float = 300.0,
                       poll: float = 0.2) -> bool:
-        deadline = time.time() + timeout
-        while time.time() < deadline:
-            if self.kv_store_multi_get(keys) is not None:
-                return True
-            time.sleep(poll)
-        return False
+        """Block until every key exists; polls through the shared backoff
+        helper (retry_call) instead of a fixed-interval spin, riding a
+        master outage inside the window.  Raises TimeoutError (message
+        carries the master's fencing epoch — a restarted master that lost
+        un-journaled keys is the first thing to rule out) on expiry."""
+        def probe():
+            # fail-fast inner call: a long per-probe deadline would let one
+            # probe swallow the whole wait window during a master outage
+            try:
+                resp = self._client._call(  # noqa: SLF001
+                    "get", msg.KVStoreMultiGetRequest(keys=keys),
+                    attempts=2)
+            except MasterUnreachableError as e:
+                raise MasterClient._KVNotReady() from e
+            if not resp.found:
+                raise MasterClient._KVNotReady()
+            return True
+
+        try:
+            return retry_call(
+                probe, attempts=None, deadline_s=timeout,
+                base_delay_s=poll, max_delay_s=2.0, jitter=0.25,
+                retry_on=(MasterClient._KVNotReady,))
+        except MasterClient._KVNotReady:
+            raise TimeoutError(
+                f"kv_store_wait({keys!r}) timed out after {timeout:.0f}s "
+                f"(master epoch={self.epoch})") from None

@@ -27,6 +27,15 @@ in the source text, so they are enforced BEFORE a chip is touched:
   driver's one-readback-per-fusion contract (CLAUDE.md dispatch
   amortization; trainer/train_step.py).  Cadence-gated readbacks
   (under an ``if`` — e.g. logging every N steps) are fine.
+- ``raw-rpc-call``     — a control-plane socket dial
+  (``socket.create_connection``, ``*sock*.connect``) or frame-level IO
+  (``_send_frame``/``_recv_frame``) outside the retry wrapper: every
+  such invocation must run inside a function that routes through
+  ``retry_call`` (common/util.py) or live in common/comm.py itself —
+  the one place the policy is implemented.  A bare dial raises on the
+  first ConnectionError, which is exactly how the control plane used
+  to die with the master (ISSUE 4); the shared policy gives bounded
+  exponential backoff + reconnect everywhere.
 
 This module is import-light on purpose: NO jax, NO package siblings —
 ``__graft_entry__.py`` runs it as a pre-flight gate before any backend
@@ -423,6 +432,86 @@ def check_blocking_readback(path: str, tree: ast.Module,
     return findings
 
 
+# --------------------------------------------------------- raw-rpc-call
+
+# the module that IS the retry wrapper — raw socket IO is its job
+RPC_WRAPPER_FILES = ("common/comm.py",)
+# frame-level helpers that imply hand-rolled RPC when called elsewhere
+FRAME_IO_CALLS = ("_send_frame", "_recv_frame")
+
+
+def _function_spans(tree: ast.Module):
+    """[(start, end, contains_retry_call)] for every function in the file."""
+    spans = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        end = max((getattr(n, "end_lineno", None) or
+                   getattr(n, "lineno", fn.lineno)
+                   for n in ast.walk(fn)), default=fn.lineno)
+        has_retry = any(
+            isinstance(n, ast.Call)
+            and _terminal_callee(n.func) == "retry_call"
+            for n in ast.walk(fn))
+        spans.append((fn.lineno, end, has_retry))
+    return spans
+
+
+def check_raw_rpc_call(path: str, tree: ast.Module,
+                       source_lines: Sequence[str]) -> List[Finding]:
+    """Socket dials / frame IO outside the shared retry wrapper.
+
+    A call site is sanctioned when ANY enclosing function also routes
+    through ``retry_call`` (the dial being the retried attempt — the
+    multi_process IPC client and the checkpoint-replica fetch are the
+    in-tree shapes), or when the file is common/comm.py.  Tests are
+    exempt: fault-injection tests open raw sockets on purpose.
+    """
+    posix = path.replace(os.sep, "/")
+    parts = posix.split("/")
+    if "tests" in parts or parts[-1].startswith("test_"):
+        return []
+    if any(posix.endswith(f) for f in RPC_WRAPPER_FILES):
+        return []
+    findings: List[Finding] = []
+    spans = _function_spans(tree)
+
+    def sanctioned(line: int) -> bool:
+        return any(s <= line <= e and has_retry
+                   for s, e, has_retry in spans)
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        dotted = _dotted(func) or ""
+        callee = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else "")
+        is_dial = (dotted in ("socket.create_connection",
+                              "create_connection")
+                   or (callee == "connect" and isinstance(
+                       func, ast.Attribute)
+                       and "sock" in (_dotted(func.value) or "").lower()))
+        is_frame_io = callee in FRAME_IO_CALLS
+        if not (is_dial or is_frame_io):
+            continue
+        line = node.lineno
+        if sanctioned(line) or _suppressed(source_lines, line,
+                                           "raw-rpc-call"):
+            continue
+        what = ("frame-level RPC IO" if is_frame_io
+                else "control-plane socket dial")
+        findings.append(Finding(
+            "raw-rpc-call",
+            f"{what} `{dotted or callee}(...)` outside the shared retry "
+            f"wrapper — route the attempt through retry_call "
+            f"(common/util.py) so it gets bounded backoff + reconnect "
+            f"instead of dying on the first ConnectionError",
+            path, line,
+            rule="control-plane sockets go through retry_call"))
+    return findings
+
+
 # ----------------------------------------------- control-plane-hygiene
 
 
@@ -572,6 +661,8 @@ def run_paths(paths: Sequence[str],
             findings.extend(check_donated_reuse(rel, tree, lines))
         if not checkers or "blocking-readback" in checkers:
             findings.extend(check_blocking_readback(rel, tree, lines))
+        if not checkers or "raw-rpc-call" in checkers:
+            findings.extend(check_raw_rpc_call(rel, tree, lines))
         if not checkers or "control-plane-hygiene" in checkers:
             findings.extend(
                 check_control_plane_hygiene(rel, tree, lines))

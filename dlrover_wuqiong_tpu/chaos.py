@@ -658,11 +658,246 @@ def preempt_warm(total_steps: int = 120, dt: float = 0.05,
     return report
 
 
+# -------------------------------------------------------------- master kill
+
+
+_MASTER_KILL_WORKER = r"""
+import json, os, sys, time
+
+from dlrover_wuqiong_tpu.trainer.elastic import init_elastic
+
+(_ckpt_dir, marker_dir, dataset_size, batch, minibatches, dt) = (
+    sys.argv[1], sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
+    int(sys.argv[5]), float(sys.argv[6]))
+ctx = init_elastic()
+restart = ctx.world.restart_count
+with open(os.path.join(marker_dir, f"start_r{restart}"), "w") as f:
+    f.write(str(os.getpid()))
+# dynamic sharding straight off the master: every fetched range and every
+# completed range is logged so the drill can prove the journal replayed
+# EXACTLY (no range lost, none handed out twice across the restart)
+sc = ctx.sharding_client("chaos-mk", batch_size=batch,
+                         dataset_size=dataset_size,
+                         num_minibatches_per_shard=minibatches)
+log = open(os.path.join(marker_dir, "shards.log"), "a")
+step = 0
+while True:
+    task = sc.fetch_shard(wait=True, timeout=120.0)
+    if task is None:
+        break
+    log.write(f"fetch {time.time():.3f} {task.task_id} "
+              f"{task.shard.start} {task.shard.end}\n")
+    log.flush()
+    for i in range((task.shard.end - task.shard.start) // batch):
+        time.sleep(dt)  # one training step
+        step += 1
+        # per-step heartbeat: CRITICAL during the drill — these are the
+        # frames that must buffer (not block, not crash) while the master
+        # is dead, then drain after reconnect
+        ctx.mc.report_heart_beat(step)
+        log.write(f"step {time.time():.3f} {step}\n")
+        log.flush()
+    sc.report_shard_done(task.task_id)
+    log.write(f"done {time.time():.3f} {task.task_id} "
+              f"{task.shard.start} {task.shard.end}\n")
+    log.flush()
+stats = ctx.mc.degraded_stats()
+with open(os.path.join(marker_dir, "done"), "w") as f:
+    json.dump({"steps": step, "stats": stats}, f)
+"""
+
+
+def master_kill(dataset_size: int = 576, batch: int = 4,
+                minibatches: int = 24, dt: float = 0.08,
+                outage_s: float = 1.5, target: float = 0.5,
+                timeout: float = 240.0) -> Dict:
+    """SIGKILL the job MASTER mid-run; restart it on the same journal.
+
+    The reference's headline claim — no single process is fatal — applied
+    to the master itself: the drill runs the real stack with the master as
+    a SEPARATE process journaling every control-plane mutation
+    (master/journal.py), hard-kills it while the worker is mid-shard, and
+    restarts it on the same journal + port.  Invariants:
+
+    - the worker NEVER crashes or restarts (exit clean, one generation);
+    - dataset ranges tile exactly: none lost, none double-trained —
+      journal replay reconstructed splitter cursors + in-flight tasks;
+    - training steps land INSIDE the outage window (elastic hooks do not
+      block on the dead master — heartbeats buffer in degraded mode);
+    - the heartbeat buffer fully drains after reconnect, and the client
+      observed the fencing-epoch bump + re-registered;
+    - wall-clock goodput (ideal step time / span) stays over `target`.
+    """
+    from .common.comm import addr_connectable, find_free_port
+
+    work = tempfile.mkdtemp(prefix="dwt-chaos-masterkill-")
+    marker = os.path.join(work, "markers")
+    journal_dir = os.path.join(work, "journal")
+    os.makedirs(marker)
+    script = os.path.join(work, "worker.py")
+    with open(script, "w") as f:
+        f.write(_MASTER_KILL_WORKER)
+    global _launch_seq
+    _launch_seq += 1
+    job = f"masterkill{os.getpid()}n{_launch_seq}"
+    port = find_free_port()
+    addr = f"127.0.0.1:{port}"
+    env = dict(
+        os.environ, DWT_JOB_NAME=job, JAX_PLATFORMS="cpu",
+        DWT_SOCKET_DIR=os.path.join(work, "sockets"),
+        PYTHONPATH=os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))) + os.pathsep +
+        os.environ.get("PYTHONPATH", ""))
+
+    def spawn_master():
+        return subprocess.Popen(
+            [sys.executable, "-m", "dlrover_wuqiong_tpu.master",
+             f"--port={port}", "--min_nodes=1", "--max_nodes=1",
+             f"--journal-dir={journal_dir}", "--poll-interval=0.5"],
+            env=env, cwd=work, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+
+    report: Dict = {"scenario": "master-kill", "outage_s": outage_s}
+    master = spawn_master()
+    cli = None
+    out = ""
+    try:
+        deadline = time.time() + 30.0
+        while time.time() < deadline and not addr_connectable(addr):
+            time.sleep(0.1)
+        if not addr_connectable(addr):
+            report.update(ok=False, error="master never came up")
+            return report
+        cli_env = dict(env, DWT_MASTER_ADDR=addr)
+        cli = subprocess.Popen(
+            [sys.executable, "-m", "dlrover_wuqiong_tpu.run",
+             "--nnodes=1", "--nproc_per_node=1", "--max_restarts=2",
+             script, os.path.join(work, "ckpt"), marker,
+             str(dataset_size), str(batch), str(minibatches), str(dt)],
+            env=cli_env, cwd=work, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+
+        # kill the master just after a mid-run shard fetch: the worker is
+        # then provably mid-shard through the outage window
+        shards_log = os.path.join(marker, "shards.log")
+        kill_after_fetches = 2
+        kill_t = restart_t = -1.0
+        deadline = time.time() + timeout / 2
+        while time.time() < deadline and cli.poll() is None:
+            try:
+                with open(shards_log) as f:
+                    fetches = sum(1 for ln in f if ln.startswith("fetch "))
+                if fetches >= kill_after_fetches:
+                    break
+            except OSError:
+                pass
+            time.sleep(0.05)
+        else:
+            report.update(ok=False, error="worker never reached the kill "
+                                          "point", cli_rc=cli.poll())
+            return report
+        time.sleep(dt * 2)  # be safely inside the shard's step loop
+        master.kill()  # SIGKILL — no snapshot, no goodbye
+        master.wait(timeout=10)
+        kill_t = time.time()
+        logger.info("master-kill: SIGKILLed master pid=%d", master.pid)
+        time.sleep(outage_s)
+        master = spawn_master()
+        deadline = time.time() + 30.0
+        while time.time() < deadline and not addr_connectable(addr):
+            time.sleep(0.05)
+        restart_t = time.time()
+        report["measured_outage_s"] = round(restart_t - kill_t, 2)
+
+        try:
+            out, _ = cli.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            cli.kill()
+            out, _ = cli.communicate()
+
+        # ------------------------------------------------------ invariants
+        report["cli_rc"] = cli.returncode
+        report["worker_generations"] = sum(
+            1 for f in os.listdir(marker) if f.startswith("start_r"))
+        done_path = os.path.join(marker, "done")
+        report["completed"] = os.path.exists(done_path)
+        stats: Dict = {}
+        if report["completed"]:
+            with open(done_path) as f:
+                payload = json.load(f)
+            stats = payload.get("stats", {})
+            report["degraded"] = stats
+        fetched, completed, steps = [], [], []
+        try:
+            with open(shards_log) as f:
+                for ln in f:
+                    parts = ln.split()
+                    if parts[0] == "fetch":
+                        fetched.append((int(parts[3]), int(parts[4])))
+                    elif parts[0] == "done":
+                        completed.append((int(parts[3]), int(parts[4])))
+                    elif parts[0] == "step":
+                        steps.append(float(parts[1]))
+        except OSError:
+            pass
+        # exact tiling: completed ranges cover [0, dataset_size) once
+        covered = sorted(completed)
+        tiles_ok = (sum(e - s for s, e in covered) == dataset_size
+                    and all(covered[i][1] == covered[i + 1][0]
+                            for i in range(len(covered) - 1))
+                    and bool(covered) and covered[0][0] == 0
+                    and covered[-1][1] == dataset_size)
+        report["shards_completed"] = len(completed)
+        report["shards_fetched"] = len(fetched)
+        report["no_shard_lost_or_double"] = bool(
+            tiles_ok and len(fetched) == len(completed))
+        report["steps_in_outage"] = sum(
+            1 for t in steps if kill_t <= t <= restart_t)
+        total_steps = dataset_size // batch
+        if steps:
+            span = max(steps) - min(steps) + dt
+            report["goodput_wall"] = round(total_steps * dt / span, 3)
+        else:
+            report["goodput_wall"] = 0.0
+        report["heartbeats_buffered"] = stats.get("buffered_total", 0)
+        report["buffer_drained"] = (stats.get("pending", 1) == 0
+                                    and stats.get("dropped_total", 1) == 0)
+        report["epoch_bumped"] = 2 in stats.get("epochs_seen", [])
+        report["reregistered"] = stats.get("reregistrations", 0) >= 1
+        report["ok"] = bool(
+            report["completed"] and cli.returncode == 0
+            and report["worker_generations"] == 1
+            and report["no_shard_lost_or_double"]
+            and report["steps_in_outage"] > 0
+            and report["heartbeats_buffered"] > 0
+            and report["buffer_drained"]
+            and report["epoch_bumped"] and report["reregistered"]
+            and report["goodput_wall"] >= target)
+        return report
+    finally:
+        if master.poll() is None:
+            master.terminate()
+            try:
+                master.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                master.kill()
+        if cli is not None and cli.poll() is None:
+            cli.kill()
+        if report.get("ok"):
+            import shutil
+
+            shutil.rmtree(work, ignore_errors=True)
+        else:
+            report["cli_tail"] = (out or "")[-2000:]
+            report["workdir"] = work
+
+
 SCENARIOS = {"pod-kill": pod_kill, "straggler": straggler,
              "network-partition": network_partition,
              "preempt": preempt, "preempt-table": preempt_table,
              "preempt-warm": preempt_warm,
-             "preempt-fused": preempt_fused}
+             "preempt-fused": preempt_fused,
+             "master-kill": master_kill}
 
 
 def main(argv=None):

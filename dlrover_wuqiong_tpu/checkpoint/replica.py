@@ -140,8 +140,16 @@ class CkptReplicaManager:
 
     def __init__(self, rank: int, peers: Dict[int, str],
                  job_name: str = "dwt", local_rank: int = 0,
-                 replica_count: int = 1, timeout: float = 120.0):
-        """peers: rank → "host:port" of every node's ReplicaServer."""
+                 replica_count: int = 1, timeout: float = 120.0,
+                 lock_timeout: float = 2.0):
+        """peers: rank → "host:port" of every node's ReplicaServer.
+
+        `timeout` bounds peer TRANSFERS (big blobs over DCN);
+        `lock_timeout` bounds the shm staging-lock acquire separately — a
+        missing lock server (no saver running: standalone replica use,
+        tests) must cost seconds, not the full transfer budget, or every
+        backup() waits out a 150s dial to a unix socket that will never
+        exist."""
         from ..common.multi_process import SharedLock
         from .ckpt_saver import shm_lock_name
 
@@ -149,6 +157,7 @@ class CkptReplicaManager:
         self.peers = dict(peers)
         self.replica_count = max(0, replica_count)
         self.timeout = timeout
+        self.lock_timeout = lock_timeout
         self._shm = SharedMemoryHandler(local_rank, job_name)
         # same lock the saver/engine use: a concurrent drain restaging the
         # segment must not tear the copy we ship
@@ -163,7 +172,7 @@ class CkptReplicaManager:
     def _segment_bytes(self) -> Optional[Tuple[int, bytes]]:
         acquired = False
         try:
-            acquired = self._seg_lock.acquire(timeout=self.timeout)
+            acquired = self._seg_lock.acquire(timeout=self.lock_timeout)
         except Exception:  # noqa: BLE001 — lock service gone: copy unlocked
             acquired = False
         try:
@@ -260,11 +269,22 @@ class CkptReplicaManager:
 
     def _rpc(self, addr: str, header: Dict,
              payload: bytes = b"") -> Tuple[Dict, bytes]:
+        from ..common.util import retry_call
+
         host, port = addr.rsplit(":", 1)
-        with socket.create_connection((host, int(port)),
-                                      timeout=self.timeout) as sock:
-            _send_msg(sock, header, payload)
-            return _recv_msg(sock)
+
+        def attempt() -> Tuple[Dict, bytes]:
+            # raw dial sanctioned: the attempt runs under retry_call
+            # (graftlint raw-rpc-call) — a peer agent mid-restart answers
+            # on the second or third try instead of being skipped for the
+            # whole backup round
+            with socket.create_connection((host, int(port)),
+                                          timeout=self.timeout) as sock:
+                _send_msg(sock, header, payload)
+                return _recv_msg(sock)
+
+        return retry_call(attempt, attempts=3, base_delay_s=0.2,
+                          max_delay_s=1.0, retry_on=(OSError,))
 
     def close(self):
         self._shm.close()

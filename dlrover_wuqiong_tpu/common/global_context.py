@@ -33,6 +33,13 @@ class Context:
     checkpoint_replica: int = 0
     # /metrics exporter port: -1 disables, 0 picks a free port
     metrics_port: int = -1
+    # master journal compaction: snapshot + truncate after this many
+    # event frames (master/journal.py); DWT_CTX_JOURNAL_SNAPSHOT_EVERY
+    journal_snapshot_every: int = 1000
+    # how long a MasterClient rides a master outage before giving up on a
+    # critical verb (retry backoff caps at ~2s between attempts); the
+    # fire-and-forget verbs buffer instead of waiting (master_client.py)
+    master_outage_grace_s: float = 120.0
     # paths
     work_dir: str = "/tmp/dwt"
     extra: dict = field(default_factory=dict)

@@ -123,30 +123,58 @@ class LocalSocketComm:
 
     # ------------------------------------------------------------------ client
 
-    def _request(self, req: Dict, timeout: float = 60.0) -> Dict:
+    class _DialBudgetExceeded(Exception):
+        """Could not even CONNECT within the caller's dial budget."""
+
+    def _request(self, req: Dict, timeout: float = 60.0,
+                 dial_timeout: Optional[float] = None) -> Dict:
+        """`timeout` bounds the whole exchange; `dial_timeout` (<= timeout)
+        separately bounds the CONNECT phase — a socket path that never
+        answers means the resource master does not exist, and callers with
+        their own fallback (lock-free staging copy, replica backup) must
+        not wait out the full exchange budget to learn that."""
         if self._master:
             return self._handle(req)
-        deadline = time.time() + timeout
+        from .util import retry_call
+
+        start = time.monotonic()
+
+        def attempt() -> Dict:
+            # raw dial sanctioned here because the whole attempt runs
+            # under retry_call (graftlint raw-rpc-call)
+            if self._client_sock is None:
+                if dial_timeout is not None and \
+                        time.monotonic() - start > dial_timeout:
+                    raise LocalSocketComm._DialBudgetExceeded()
+                self._client_sock = socket.socket(socket.AF_UNIX,
+                                                  socket.SOCK_STREAM)
+                self._client_sock.connect(self._path)
+            _send(self._client_sock, req)
+            resp = _recv(self._client_sock)
+            if "err" in resp:
+                raise RuntimeError(resp["err"])
+            return resp
+
+        def drop_sock(_n, _exc, _delay):
+            if self._client_sock is not None:
+                self._client_sock.close()
+                self._client_sock = None
+
         with self._client_lock:
-            while True:
-                try:
-                    if self._client_sock is None:
-                        self._client_sock = socket.socket(socket.AF_UNIX,
-                                                          socket.SOCK_STREAM)
-                        self._client_sock.connect(self._path)
-                    _send(self._client_sock, req)
-                    resp = _recv(self._client_sock)
-                    if "err" in resp:
-                        raise RuntimeError(resp["err"])
-                    return resp
-                except (ConnectionError, FileNotFoundError, OSError):
-                    if self._client_sock is not None:
-                        self._client_sock.close()
-                        self._client_sock = None
-                    if time.time() > deadline:
-                        raise TimeoutError(
-                            f"IPC resource {self._name} unreachable")
-                    time.sleep(0.1)
+            try:
+                # flat 0.1s cadence preserved (jitterless, max=base): the
+                # master side comes up once and stays — backoff would only
+                # delay the first contact
+                return retry_call(
+                    attempt, attempts=None, deadline_s=timeout,
+                    base_delay_s=0.1, max_delay_s=0.1, jitter=0.0,
+                    retry_on=(ConnectionError, FileNotFoundError, OSError),
+                    on_retry=drop_sock)
+            except (LocalSocketComm._DialBudgetExceeded, ConnectionError,
+                    FileNotFoundError, OSError) as e:
+                drop_sock(0, e, 0.0)
+                raise TimeoutError(
+                    f"IPC resource {self._name} unreachable") from e
 
 
 def _pid_alive(pid: int) -> bool:
@@ -226,12 +254,16 @@ class SharedLock(LocalSocketComm):
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
         # client timeout must outlast the server's poll loop, not cut the
         # socket mid-wait (the server would keep polling for a vanished
-        # waiter and hand it a lock nobody releases)
+        # waiter and hand it a lock nobody releases) — but the CONNECT
+        # phase is bounded by the caller's own timeout: when the lock
+        # master does not exist at all, the caller learns it within its
+        # budget instead of the 60s rpc floor
         rpc_timeout = max(60.0, timeout + 30.0) if timeout and timeout > 0 \
             else 7 * 24 * 3600.0
+        dial = max(0.2, timeout) if timeout and timeout > 0 else None
         return self._request({"op": "acquire", "blocking": blocking,
                               "timeout": timeout, "pid": os.getpid()},
-                             timeout=rpc_timeout)["ok"]
+                             timeout=rpc_timeout, dial_timeout=dial)["ok"]
 
     def release(self):
         self._request({"op": "release"})

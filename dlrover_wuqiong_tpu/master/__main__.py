@@ -1,0 +1,43 @@
+"""``python -m dlrover_wuqiong_tpu.master`` — standalone master process.
+
+Parity: reference `dlrover/python/master/main.py` (run :43) — the
+out-of-process deployment shape (one master pod per job).  With
+``--journal-dir`` the master journals every control-plane mutation
+(master/journal.py); a replacement process started on the same directory
+replays the state, bumps the fencing epoch, and the workers ride through
+(`python -m dlrover_wuqiong_tpu.chaos master-kill` is the proof drill).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from .master import run_master_forever
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m dlrover_wuqiong_tpu.master",
+        description="standalone elastic-training job master")
+    p.add_argument("--port", type=int, default=0,
+                   help="RPC port (0 picks a free one)")
+    p.add_argument("--min_nodes", type=int, default=1)
+    p.add_argument("--max_nodes", type=int, default=1)
+    p.add_argument("--node_unit", type=int, default=1)
+    p.add_argument("--journal-dir", default="",
+                   help="enable the control-plane journal here; a restarted "
+                        "master on the same dir replays it")
+    p.add_argument("--poll-interval", type=float, default=5.0)
+    p.add_argument("--max-seconds", type=float, default=None,
+                   help="abort the job after this much wall clock")
+    args = p.parse_args(argv)
+    return run_master_forever(
+        args.port, args.min_nodes, args.max_nodes, node_unit=args.node_unit,
+        journal_dir=args.journal_dir or None,
+        poll_interval=args.poll_interval, max_seconds=args.max_seconds)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import json
 import random
+import zlib
 from abc import ABC, abstractmethod
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -24,6 +25,17 @@ class Shard:
     start: int
     end: int
     record_indices: List[int] = field(default_factory=list)
+
+
+def _epoch_rng(dataset_name: str, epoch: int) -> random.Random:
+    """Deterministic per-(dataset, epoch) shuffle RNG.
+
+    The master journal (master/journal.py) replays shard dispatches by
+    task id after a master restart; a global-RNG shuffle would give the
+    REPLAYED epoch a different shard order in the new process and silently
+    re-train ranges under the same ids.  crc32 (not hash()) because python
+    salts string hashes per process."""
+    return random.Random(zlib.crc32(f"{dataset_name}:{epoch}".encode()))
 
 
 class DatasetSplitter(ABC):
@@ -76,7 +88,7 @@ class TableDatasetSplitter(DatasetSplitter):
     def create_shards(self):
         starts = list(range(0, self.dataset_size, self.shard_size))
         if self.shuffle:
-            random.shuffle(starts)
+            _epoch_rng(self.dataset_name, self.epoch).shuffle(starts)
         self._shards = [
             Shard(self.dataset_name, s, min(s + self.shard_size,
                                             self.dataset_size))
@@ -121,7 +133,7 @@ class TextDatasetSplitter(DatasetSplitter):
     def create_shards(self):
         indices = list(range(self.dataset_size))
         if self.shuffle:
-            random.shuffle(indices)
+            _epoch_rng(self.dataset_name, self.epoch).shuffle(indices)
         self._shards = []
         for i in range(0, self.dataset_size, self.shard_size):
             chunk = indices[i:i + self.shard_size]

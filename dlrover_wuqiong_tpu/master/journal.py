@@ -1,0 +1,247 @@
+"""Append-only control-plane journal: the master's state survives the master.
+
+Parity: the reference keeps all master state in memory and relies on the
+job restarting from scratch when the master pod dies
+(`dlrover/python/master/dist_master.py:86` composes managers with no
+persistence; `master/shard/task_manager.py:37` queues are process-local).
+Redesign for the TPU stack's "no single process is fatal" claim
+(PAPERS.md: Chameleon 2508.21613 recovery policy, PHOENIX 2607.01646
+near-zero-loss state reconstruction): every mutating control-plane event
+is appended here as a typed-JSON frame (common/serialize.py — same wire
+codec as the RPC layer, no pickle), and a restarted master replays the
+file to reconstruct splitter cursors, in-flight tasks, rendezvous worlds,
+the kv store, the node registry and the paral config, then bumps a
+**fencing epoch** that every RPC response carries so clients detect the
+new incarnation (common/comm.py).
+
+Format: one frame per line (`serialize.dumps` emits compact JSON with no
+raw newlines).  Every frame carries a monotonically increasing ``seq``;
+the snapshot records the seq it covers, so replay after a crash BETWEEN
+"snapshot written" and "journal truncated" skips the already-snapshotted
+prefix instead of double-applying (kv_store_add replayed twice would
+drift the counter).  A torn final line — the master was SIGKILLed
+mid-append — is detected by the JSON decoder and dropped with a warning;
+the event it described was never acknowledged to any client (append
+happens before the response frame), so dropping it is exactly at-most-once.
+
+Layout under ``dir``:
+  journal.frames   append-only event log (truncated at each compaction)
+  snapshot.frame   single frame: {"epoch": int, "seq": int, "state": {...}}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..common import serialize
+from ..common.log import get_logger
+
+logger = get_logger("journal")
+
+JOURNAL_FILE = "journal.frames"
+SNAPSHOT_FILE = "snapshot.frame"
+
+
+class MasterJournal:
+    """Event log + snapshot/compaction for one master's control plane."""
+
+    def __init__(self, journal_dir: str, fsync: bool = True,
+                 snapshot_every: int = 1000):
+        self.dir = journal_dir
+        os.makedirs(journal_dir, exist_ok=True)
+        self._path = os.path.join(journal_dir, JOURNAL_FILE)
+        self._snap_path = os.path.join(journal_dir, SNAPSHOT_FILE)
+        self._fsync = fsync
+        self.snapshot_every = max(1, snapshot_every)
+        self._lock = threading.Lock()
+        self._fh = None
+        self._seq = 0
+        self.epoch = 0
+        self.entries_since_snapshot = 0
+
+    # ----------------------------------------------------------------- load
+
+    def load(self) -> Tuple[Optional[Dict], List[Dict]]:
+        """Read (snapshot_state, replay_entries) and prime seq/epoch.
+
+        `replay_entries` excludes frames already covered by the snapshot's
+        seq.  Must be called before `open_epoch()`/`append()`.
+        """
+        snapshot: Optional[Dict] = None
+        snap_seq = 0
+        last_epoch = 0
+        if os.path.exists(self._snap_path):
+            try:
+                with open(self._snap_path, "rb") as f:
+                    frame = serialize.loads(f.read())
+                snapshot = frame.get("state")
+                snap_seq = int(frame.get("seq", 0))
+                last_epoch = int(frame.get("epoch", 0))
+            except (OSError, ValueError, json.JSONDecodeError) as e:
+                logger.error("snapshot unreadable (%s) — replaying the "
+                             "full journal", e)
+                snapshot, snap_seq = None, 0
+        entries: List[Dict] = []
+        max_seq = snap_seq
+        if os.path.exists(self._path):
+            with open(self._path, "rb") as f:
+                lines = f.read().split(b"\n")
+            for i, line in enumerate(lines):
+                if not line.strip():
+                    continue
+                try:
+                    frame = serialize.loads(line)
+                except (ValueError, json.JSONDecodeError):
+                    # torn tail from a hard kill mid-append: never acked,
+                    # safe to drop.  A torn line mid-file would shadow
+                    # later intact frames — stop there and say so.
+                    dropped = sum(1 for l in lines[i + 1:] if l.strip())
+                    logger.warning(
+                        "journal: dropping torn frame at line %d (+%d "
+                        "after it)", i + 1, dropped)
+                    break
+                seq = int(frame.get("seq", 0))
+                max_seq = max(max_seq, seq)
+                if frame.get("kind") == "epoch":
+                    last_epoch = max(last_epoch,
+                                     int(frame["data"]["epoch"]))
+                    continue
+                if seq <= snap_seq:
+                    continue  # already inside the snapshot
+                entries.append(frame)
+        self._seq = max_seq
+        self.epoch = last_epoch
+        return snapshot, entries
+
+    # --------------------------------------------------------------- append
+
+    def open_epoch(self) -> int:
+        """Bump + persist the fencing epoch for this master incarnation."""
+        self.epoch += 1
+        self.append("epoch", {"epoch": self.epoch})
+        logger.info("journal %s: epoch %d open (seq=%d)", self.dir,
+                    self.epoch, self._seq)
+        return self.epoch
+
+    def append(self, kind: str, data: Dict[str, Any]):
+        """Append one event frame; flushed (and fsynced) before return so
+        an acked RPC implies a durable record."""
+        with self._lock:
+            self._seq += 1
+            frame = serialize.dumps({"seq": self._seq, "kind": kind,
+                                     "data": data})
+            try:
+                if self._fh is None:
+                    self._fh = open(self._path, "ab")
+                self._fh.write(frame + b"\n")
+                self._fh.flush()
+                if self._fsync:
+                    os.fsync(self._fh.fileno())
+            except OSError:
+                # durability degraded, availability preserved: the master
+                # keeps serving (a full disk must not take training down)
+                logger.exception("journal append failed (kind=%s)", kind)
+                return
+            if kind != "epoch":
+                self.entries_since_snapshot += 1
+
+    # ------------------------------------------------------------- snapshot
+
+    def snapshot(self, state: Dict[str, Any]):
+        """Write a full-state snapshot and truncate the event log.
+
+        Crash-safe ordering: tmp-write + rename the snapshot FIRST, then
+        truncate the journal.  A crash in between replays seq-duplicated
+        frames, which `load()` skips via the snapshot's seq watermark.
+        """
+        with self._lock:
+            frame = serialize.dumps({"epoch": self.epoch, "seq": self._seq,
+                                     "state": state})
+            tmp = self._snap_path + ".tmp"
+            try:
+                with open(tmp, "wb") as f:
+                    f.write(frame)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, self._snap_path)
+                if self._fh is not None:
+                    self._fh.close()
+                    self._fh = None
+                # fresh journal holding only the current epoch marker
+                jtmp = self._path + ".tmp"
+                with open(jtmp, "wb") as f:
+                    self._seq += 1
+                    f.write(serialize.dumps(
+                        {"seq": self._seq, "kind": "epoch",
+                         "data": {"epoch": self.epoch}}) + b"\n")
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(jtmp, self._path)
+            except OSError:
+                logger.exception("journal compaction failed")
+                return
+            self.entries_since_snapshot = 0
+            logger.info("journal %s: snapshot at seq=%d epoch=%d",
+                        self.dir, self._seq, self.epoch)
+
+    def close(self):
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+class IdemCache:
+    """Bounded idempotency-key → response cache (at-most-once replay).
+
+    Parity: no reference counterpart — the reference's gRPC verbs are
+    retried against the SAME master process, where re-applying a task
+    result is harmless; here a retry can cross a master restart, so
+    mutating verbs carry keys and the journaled cache answers replays
+    with the recorded response instead of re-applying the mutation.
+    """
+
+    def __init__(self, cap: int = 4096):
+        self._cap = cap
+        self._lock = threading.Lock()
+        self._map: "OrderedDict[str, Any]" = OrderedDict()
+
+    _MISS = object()
+
+    def get(self, key: str) -> Any:
+        """The cached response, or IdemCache.MISS."""
+        with self._lock:
+            if key in self._map:
+                self._map.move_to_end(key)
+                return self._map[key]
+            return self._MISS
+
+    @property
+    def MISS(self):
+        return self._MISS
+
+    def put(self, key: str, resp: Any):
+        with self._lock:
+            self._map[key] = resp
+            self._map.move_to_end(key)
+            while len(self._map) > self._cap:
+                self._map.popitem(last=False)
+
+    def export_state(self) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self._map)
+
+    def restore_state(self, data: Dict[str, Any]):
+        with self._lock:
+            for k, v in data.items():
+                self._map[k] = v
+            while len(self._map) > self._cap:
+                self._map.popitem(last=False)
+
+    def __len__(self):
+        with self._lock:
+            return len(self._map)

@@ -57,3 +57,16 @@ class KVStoreService:
     def clear(self):
         with self._lock:
             self._store.clear()
+
+    # ------------------------------------------------------- journal replay
+
+    def export_state(self) -> Dict[str, bytes]:
+        """Snapshot for the master journal (bytes values round-trip
+        through common/serialize's hex encoding)."""
+        with self._lock:
+            return dict(self._store)
+
+    def restore_state(self, data: Dict[str, bytes]):
+        with self._cond:
+            self._store.update(data)
+            self._cond.notify_all()

@@ -42,7 +42,8 @@ class JobMaster:
     def __init__(self, port: int = 0, min_nodes: int = 1,
                  max_nodes: int = 1, node_unit: int = 1,
                  scaler: Optional[Scaler] = None,
-                 job_manager: Optional[JobManager] = None):
+                 job_manager: Optional[JobManager] = None,
+                 journal_dir: Optional[str] = None):
         ctx = get_context()
         self.speed_monitor = SpeedMonitor(ctx.train_speed_record_num)
         self.job_manager = job_manager or LocalJobManager(scaler=scaler)
@@ -89,6 +90,23 @@ class JobMaster:
         self._custom_metrics: Dict = {}
         self._node_events: list = []
         self._paral_config = msg.ParallelConfig()
+        # ------------------------------------------------- fault tolerance
+        # journal + fencing epoch (master/journal.py): with a journal dir,
+        # this master replays any prior incarnation's control-plane state
+        # and serves a bumped epoch so clients re-register/re-sync; without
+        # one it is epoch 1 forever (standalone/test masters).
+        from .journal import IdemCache, MasterJournal
+
+        self.idem_cache = IdemCache()
+        self.epoch = 1
+        jd = journal_dir or os.getenv("DWT_MASTER_JOURNAL_DIR", "")
+        self.journal = MasterJournal(
+            jd, snapshot_every=ctx.journal_snapshot_every) if jd else None
+        if self.journal is not None:
+            self._replay_journal()
+            self.epoch = self.journal.open_epoch()
+            for name, rdzv in self.rdzv_managers.items():
+                rdzv.on_world_formed = self._journal_world
         self._server = create_master_service(self, port=port)
         self._exit_code = 0
         self._exit_reason = ""
@@ -130,6 +148,134 @@ class JobMaster:
         if self._exporter is not None:
             self._exporter.stop()
         self._server.stop()
+        if self.journal is not None:
+            # clean shutdown: compact so the next incarnation boots from
+            # one snapshot frame (crash paths never reach here — replay
+            # covers them)
+            self.snapshot_journal()
+            self.journal.close()
+
+    # ------------------------------------------------------- fault tolerance
+
+    def _journal_world(self, name: str, state: Dict):
+        if self.journal is not None:
+            self.journal.append("rdzv_world", {"name": name,
+                                               "state": state})
+
+    def _replay_journal(self):
+        """Reconstruct control-plane state from snapshot + event frames."""
+        snapshot, entries = self.journal.load()
+        if snapshot:
+            self._restore_snapshot(snapshot)
+        applied = 0
+        for frame in entries:
+            try:
+                self._apply_entry(frame.get("kind", ""),
+                                  frame.get("data", {}))
+                applied += 1
+            except Exception:  # noqa: BLE001 — one bad frame must not
+                # take down recovery of everything after it
+                logger.exception("journal replay: frame %s failed",
+                                 frame.get("seq"))
+        if snapshot or applied:
+            logger.info("journal replay: snapshot=%s + %d events "
+                        "(last epoch %d)", bool(snapshot), applied,
+                        self.journal.epoch)
+
+    def _restore_snapshot(self, state: Dict):
+        if state.get("task_manager"):
+            self.task_manager.restore_state(state["task_manager"])
+        if state.get("kv"):
+            self.kv_store.restore_state(state["kv"])
+        for name, rstate in (state.get("rdzv") or {}).items():
+            rdzv = self.rdzv_managers.get(name)
+            if rdzv is not None:
+                rdzv.restore_state(rstate)
+        for node_type, node_id, rank, addr in state.get("nodes", []):
+            self.job_manager.register_node(node_type, node_id,
+                                           rank_index=rank, addr=addr)
+        if state.get("paral") is not None:
+            self._paral_config = state["paral"]
+        if state.get("idem"):
+            self.idem_cache.restore_state(state["idem"])
+
+    def _apply_entry(self, kind: str, data: Dict):
+        data = dict(data)
+        idem = data.pop("idem", None)
+        resp = data.pop("resp", None)
+        if kind == "dataset":
+            self.task_manager.new_dataset(**data)
+        elif kind == "dispatch":
+            self.task_manager.replay_dispatch(
+                data["dataset_name"], data["task_id"], data["node_id"],
+                data["start"], data["end"], data.get("indices"))
+        elif kind == "task_result":
+            self.task_manager.replay_task_result(
+                data["dataset_name"], data["task_id"], data["success"])
+        elif kind == "recover":
+            self.task_manager.recover_tasks(data["node_id"])
+            for rdzv in self.rdzv_managers.values():
+                rdzv.remove_alive_node(data["node_id"])
+        elif kind == "kv_set":
+            self.kv_store.set(data["key"], data["value"])
+        elif kind == "kv_add":
+            if "result" in data:  # absolute value — replay converges even
+                # when the frame raced a concurrent snapshot
+                self.kv_store.set(data["key"],
+                                  str(int(data["result"])).encode())
+            else:
+                self.kv_store.add(data["key"], data["amount"])
+        elif kind == "rdzv_join":
+            rdzv = self.rdzv_managers.get(data["rdzv_name"])
+            if rdzv is not None:
+                rdzv.join_rendezvous(
+                    data["node_id"], data["node_rank"],
+                    data["local_world_size"], data.get("node_ip", ""),
+                    data.get("free_port", 0), data.get("slice_id", ""))
+            self.job_manager.register_node("worker", data["node_id"],
+                                           rank_index=data["node_rank"])
+        elif kind == "rdzv_world":
+            rdzv = self.rdzv_managers.get(data["name"])
+            if rdzv is not None:
+                rdzv.restore_state(data["state"])
+        elif kind == "node":
+            node = self.job_manager.register_node(
+                data["node_type"], data["node_id"],
+                rank_index=data["node_rank"], addr=data.get("addr", ""))
+            node.config_resource.accelerator_type = \
+                data.get("accelerator_type", "")
+            node.config_resource.accelerator_num = \
+                data.get("accelerator_num", 0)
+        elif kind == "paral":
+            self._paral_config = data["config"]
+        elif kind == "shard_ckpt":
+            self.task_manager.restore_dataset_from_checkpoint(
+                data["content"])
+        else:
+            logger.warning("journal replay: unknown frame kind %r", kind)
+        if idem:
+            self.idem_cache.put(idem, resp)
+
+    def _journal_state(self) -> Dict:
+        """Full snapshot payload (message objects ride the serialize
+        codec natively — no second encoding)."""
+        return {
+            "task_manager": self.task_manager.export_state(),
+            "kv": self.kv_store.export_state(),
+            "rdzv": {name: r.export_state()
+                     for name, r in self.rdzv_managers.items()},
+            "nodes": [[n.type, n.id, n.rank_index, n.addr]
+                      for n in self.job_manager.all_nodes()],
+            "paral": self._paral_config,
+            "idem": self.idem_cache.export_state(),
+        }
+
+    def snapshot_journal(self):
+        if self.journal is not None:
+            try:
+                self.journal.snapshot(self._journal_state())
+            except Exception:  # noqa: BLE001 — compaction must not kill
+                logger.exception("journal snapshot failed")
 
     # --------------------------------------------------------------- hooks
 
@@ -139,6 +285,8 @@ class JobMaster:
     def update_paral_config(self, config: msg.ParallelConfig):
         config.restart_version = self._paral_config.restart_version + 1
         self._paral_config = config
+        if self.journal is not None:
+            self.journal.append("paral", {"config": config})
 
     def collect_custom_data(self, payload):
         self._custom_metrics[type(payload).__name__] = payload
@@ -174,6 +322,10 @@ class JobMaster:
         start = time.time()
         while not self._stopped.wait(poll_interval):
             self._collect_metrics()
+            if self.journal is not None and \
+                    self.journal.entries_since_snapshot >= \
+                    self.journal.snapshot_every:
+                self.snapshot_journal()
             if max_seconds and time.time() - start > max_seconds:
                 self._exit_reason = JobExitReason.UNCOMPLETED_TIMEOUT
                 self._exit_code = 1
@@ -230,12 +382,16 @@ class JobMaster:
 
 
 def run_master_forever(port: int, min_nodes: int, max_nodes: int,
-                       node_unit: int = 1):
+                       node_unit: int = 1,
+                       journal_dir: Optional[str] = None,
+                       poll_interval: float = 5.0,
+                       max_seconds: Optional[float] = None):
     """Entry for a standalone master process (parity master/main.py:63)."""
     master = JobMaster(port=port, min_nodes=min_nodes, max_nodes=max_nodes,
-                       node_unit=node_unit)
+                       node_unit=node_unit, journal_dir=journal_dir)
     master.prepare()
     try:
-        return master.run()
+        return master.run(poll_interval=poll_interval,
+                          max_seconds=max_seconds)
     finally:
         master.stop()

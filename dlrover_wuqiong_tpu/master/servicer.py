@@ -2,12 +2,20 @@
 
 Parity: reference `dlrover/python/master/servicer.py` (`MasterServicer.get` :98,
 `.report` :296) — dispatch keyed on message type.
+
+Master fault tolerance (master/journal.py): every state-mutating verb is
+journaled here, after the managers applied it and before the response frame
+leaves — an acked mutation is a durable one.  Verbs that arrive with an
+idempotency key (``idem``) are answered from the journaled idem cache when
+retried across a master restart, so report_task_result / kv_store_add /
+join_rendezvous stay at-most-once even when the retry lands on a freshly
+replayed master.
 """
 
 from __future__ import annotations
 
 import time
-from typing import Any
+from typing import Any, Optional
 
 from ..common import messages as msg
 from ..common.comm import RpcServer
@@ -25,12 +33,37 @@ class MasterServicer:
     # --------------------------------------------------------------- dispatch
 
     def handle(self, verb: str, node_id: int, node_type: str,
-               payload: Any) -> Any:
+               payload: Any, idem: Optional[str] = None) -> Any:
+        cache = getattr(self.m, "idem_cache", None)
+        if idem and cache is not None:
+            hit = cache.get(idem)
+            if hit is not cache.MISS:
+                logger.info("idem replay for %s (%s) — returning the "
+                            "recorded response", idem,
+                            type(payload).__name__)
+                return hit
         if verb == "get":
-            return self._get(node_id, node_type, payload)
-        return self._report(node_id, node_type, payload)
+            resp = self._get(node_id, node_type, payload, idem=idem)
+        else:
+            resp = self._report(node_id, node_type, payload, idem=idem)
+        if idem and cache is not None:
+            cache.put(idem, resp)
+        return resp
 
-    def _get(self, node_id: int, node_type: str, payload: Any) -> Any:
+    def _journal(self, kind: str, data: dict, idem: Optional[str] = None,
+                 resp: Any = None):
+        """Append one event frame; idem-keyed events carry their response
+        so replay rebuilds the at-most-once cache atomically with the
+        mutation (a separate idem frame could be lost between appends)."""
+        journal = getattr(self.m, "journal", None)
+        if journal is None:
+            return
+        if idem:
+            data = {**data, "idem": idem, "resp": resp}
+        journal.append(kind, data)
+
+    def _get(self, node_id: int, node_type: str, payload: Any,
+             idem: Optional[str] = None) -> Any:
         m = self.m
         if isinstance(payload, msg.TaskRequest):
             task = m.task_manager.get_dataset_task(node_id,
@@ -41,12 +74,22 @@ class MasterServicer:
                     task_id=-1,
                     task_type="none" if finished else "wait",
                     dataset_name=payload.dataset_name)
-            return msg.Task(
+            resp = msg.Task(
                 task_id=task.task_id, task_type=task.task_type,
                 shard=msg.ShardConfig(start=task.shard.start,
                                       end=task.shard.end,
                                       indices=task.shard.record_indices),
                 dataset_name=payload.dataset_name)
+            # idem matters here: a retried TaskRequest crossing a master
+            # restart must get the SAME task back — a fresh dispatch would
+            # strand the journaled one in `doing` forever
+            self._journal("dispatch", {
+                "dataset_name": payload.dataset_name,
+                "task_id": task.task_id, "node_id": node_id,
+                "start": task.shard.start, "end": task.shard.end,
+                "indices": task.shard.record_indices},
+                idem=idem, resp=resp)
+            return resp
 
         if isinstance(payload, msg.CommWorldRequest):
             rdzv = m.rdzv_managers.get(payload.rdzv_name)
@@ -90,7 +133,18 @@ class MasterServicer:
 
         if isinstance(payload, msg.KVStoreAddRequest):
             num = m.kv_store.add(payload.key, payload.amount)
-            return msg.KVStoreResponse(found=True, num=num)
+            resp = msg.KVStoreResponse(found=True, num=num)
+            # counter adds are NOT naturally idempotent — the idem key and
+            # response ride in the same frame so a cross-restart retry
+            # replays the answer instead of drifting the counter; the
+            # ABSOLUTE result is journaled (replay = set, last-writer-wins)
+            # so a frame that races a concurrent snapshot converges instead
+            # of double-adding
+            self._journal("kv_add", {"key": payload.key,
+                                     "amount": payload.amount,
+                                     "result": num},
+                          idem=idem, resp=resp)
+            return resp
 
         if isinstance(payload, msg.ShardCheckpointRequest):
             content = m.task_manager.get_dataset_checkpoint(
@@ -102,7 +156,8 @@ class MasterServicer:
 
         raise ValueError(f"unknown get message: {type(payload).__name__}")
 
-    def _report(self, node_id: int, node_type: str, payload: Any) -> Any:
+    def _report(self, node_id: int, node_type: str, payload: Any,
+                idem: Optional[str] = None) -> Any:
         m = self.m
         if isinstance(payload, msg.JoinRendezvousRequest):
             rdzv = m.rdzv_managers.get(payload.rdzv_name)
@@ -112,16 +167,28 @@ class MasterServicer:
             m.job_manager.register_node("worker", payload.node_id,
                                         rank_index=payload.node_rank)
             m.job_manager.collect_heartbeat(payload.node_id)
-            return msg.RendezvousState(rdzv_round=rdzv_round)
+            resp = msg.RendezvousState(rdzv_round=rdzv_round)
+            self._journal("rdzv_join", {
+                "rdzv_name": payload.rdzv_name, "node_id": payload.node_id,
+                "node_rank": payload.node_rank,
+                "local_world_size": payload.local_world_size,
+                "node_ip": payload.node_ip, "free_port": payload.free_port,
+                "slice_id": payload.slice_id}, idem=idem, resp=resp)
+            return resp
 
         if isinstance(payload, msg.TaskResult):
             success = not payload.err_message
             m.task_manager.report_dataset_task(
                 node_id, payload.dataset_name, payload.task_id, success)
-            return msg.OkResponse()
+            resp = msg.OkResponse()
+            self._journal("task_result", {
+                "dataset_name": payload.dataset_name,
+                "task_id": payload.task_id, "node_id": node_id,
+                "success": success}, idem=idem, resp=resp)
+            return resp
 
         if isinstance(payload, msg.DatasetShardParams):
-            m.task_manager.new_dataset(
+            created = m.task_manager.new_dataset(
                 batch_size=payload.batch_size,
                 dataset_size=payload.dataset_size,
                 dataset_name=payload.dataset_name,
@@ -130,6 +197,17 @@ class MasterServicer:
                 num_minibatches_per_shard=payload.num_minibatches_per_shard,
                 storage_type=payload.storage_type,
                 task_type=payload.task_type)
+            if created:
+                self._journal("dataset", {
+                    "batch_size": payload.batch_size,
+                    "dataset_size": payload.dataset_size,
+                    "dataset_name": payload.dataset_name,
+                    "num_epochs": payload.num_epochs,
+                    "shuffle": payload.shuffle,
+                    "num_minibatches_per_shard":
+                        payload.num_minibatches_per_shard,
+                    "storage_type": payload.storage_type,
+                    "task_type": payload.task_type})
             return msg.OkResponse()
 
         if isinstance(payload, msg.HeartBeat):
@@ -149,6 +227,11 @@ class MasterServicer:
             node.config_resource.memory_mb = payload.memory_mb
             node.config_resource.accelerator_type = payload.accelerator_type
             node.config_resource.accelerator_num = payload.accelerator_num
+            self._journal("node", {
+                "node_type": payload.node_type, "node_id": payload.node_id,
+                "node_rank": payload.node_rank, "addr": payload.addr,
+                "accelerator_type": payload.accelerator_type,
+                "accelerator_num": payload.accelerator_num})
             return msg.OkResponse()
 
         if isinstance(payload, msg.NetworkCheckResult):
@@ -178,6 +261,10 @@ class MasterServicer:
             m.task_manager.recover_tasks(payload.node_id)
             for rdzv in m.rdzv_managers.values():
                 rdzv.remove_alive_node(payload.node_id)
+            # journal the shard recovery (not the classification — error
+            # history is advisory): a replayed master must not keep the
+            # dead node's shards parked in `doing` forever
+            self._journal("recover", {"node_id": payload.node_id})
             # tell the agent whether process restarts can fix this class —
             # a user-code error restarts into the same crash every time,
             # and a class repeating across restarts is equally unfixable
@@ -198,11 +285,15 @@ class MasterServicer:
 
         if isinstance(payload, msg.KVStoreSetRequest):
             m.kv_store.set(payload.key, payload.value)
+            self._journal("kv_set", {"key": payload.key,
+                                     "value": payload.value})
             return msg.OkResponse()
 
         if isinstance(payload, msg.ShardCheckpoint):
             ok = m.task_manager.restore_dataset_from_checkpoint(
                 payload.content)
+            if ok:
+                self._journal("shard_ckpt", {"content": payload.content})
             return msg.OkResponse(success=ok)
 
         if isinstance(payload, msg.ResourceStats):
@@ -227,4 +318,5 @@ def create_master_service(job_master, host: str = "0.0.0.0",
                           port: int = 0) -> RpcServer:
     """Parity: reference servicer.py:630 create_master_service."""
     servicer = MasterServicer(job_master)
-    return RpcServer(servicer.handle, host=host, port=port)
+    return RpcServer(servicer.handle, host=host, port=port,
+                     epoch_provider=lambda: getattr(job_master, "epoch", 1))

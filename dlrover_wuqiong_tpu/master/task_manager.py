@@ -111,6 +111,73 @@ class DatasetManager:
             mgr._task_id += 1
         return mgr
 
+    # ------------------------------------------------------- journal replay
+
+    def export_state(self) -> Dict:
+        """Exact snapshot for the master journal: unlike `to_checkpoint`
+        (worker-facing, merges doing into todo and renumbers), this keeps
+        task IDS and the doing map so a restarted master can still match a
+        worker's in-flight `report_task_result` — the no-double-train
+        invariant (master/journal.py)."""
+        return {
+            "splitter": self.splitter.to_checkpoint(),
+            "task_type": self.task_type,
+            "batch_size": self.batch_size,
+            "next_task_id": self._task_id,
+            "todo": [[t.task_id, t.shard.start, t.shard.end,
+                      t.shard.record_indices] for t in self.todo],
+            "doing": [[d.task.task_id, d.node_id, d.task.shard.start,
+                       d.task.shard.end, d.task.shard.record_indices]
+                      for d in self.doing.values()],
+        }
+
+    @classmethod
+    def from_state(cls, data: Dict) -> "DatasetManager":
+        splitter = DatasetSplitter.from_checkpoint(data["splitter"])
+        mgr = cls(data["task_type"], data["batch_size"], splitter)
+        mgr._task_id = int(data.get("next_task_id", 0))
+        name = splitter.dataset_name
+        for tid, start, end, indices in data.get("todo", []):
+            mgr.todo.append(DatasetTask(
+                tid, mgr.task_type, Shard(name, start, end, indices or [])))
+        for tid, node_id, start, end, indices in data.get("doing", []):
+            mgr.doing[tid] = DoingTask(
+                DatasetTask(tid, mgr.task_type,
+                            Shard(name, start, end, indices or [])),
+                node_id, time.time())
+        return mgr
+
+    def replay_dispatch(self, task_id: int, node_id: int, start: int,
+                        end: int, indices: Optional[List[int]] = None):
+        """Re-apply a journaled `get_task` dispatch: move the task from
+        todo to doing(node).  Shard creation on epoch rollover is
+        reproduced (splitter shuffles are seeded, dataset_splitter.py),
+        and a task the replayed todo does not hold is synthesized from the
+        journal's own shard payload — the journal is authoritative."""
+        if task_id in self.doing:
+            return
+        task = self._pop_todo(task_id)
+        if task is None and not self.todo \
+                and not self.splitter.epoch_finished():
+            self.create_tasks()  # the rollover get_task() triggered live
+            task = self._pop_todo(task_id)
+        if task is None:
+            task = DatasetTask(
+                task_id, self.task_type,
+                Shard(self.splitter.dataset_name, start, end, indices or []))
+            # drop any todo entry covering the same range — it IS this task
+            self.todo = [t for t in self.todo
+                         if not (t.shard.start == start
+                                 and t.shard.end == end)]
+        self._task_id = max(self._task_id, task_id + 1)
+        self.doing[task_id] = DoingTask(task, node_id, time.time())
+
+    def _pop_todo(self, task_id: int) -> Optional[DatasetTask]:
+        for i, t in enumerate(self.todo):
+            if t.task_id == task_id:
+                return self.todo.pop(i)
+        return None
+
 
 class TaskManager:
     """Dispatches dataset shards to workers; detects task hang.
@@ -132,10 +199,12 @@ class TaskManager:
                     shuffle: bool = False,
                     num_minibatches_per_shard: int = 2,
                     storage_type: str = "",
-                    task_type: str = TaskType.TRAINING):
+                    task_type: str = TaskType.TRAINING) -> bool:
+        """Create the dataset; returns False when it already exists (the
+        journal records only the first creation)."""
         with self._lock:
             if dataset_name in self._datasets:
-                return
+                return False
             splitter = new_dataset_splitter(
                 storage_type, shuffle, dataset_size, batch_size, num_epochs,
                 num_minibatches_per_shard, dataset_name)
@@ -144,6 +213,7 @@ class TaskManager:
             self._datasets[dataset_name] = mgr
             logger.info("new dataset %s: size=%d shards=%d", dataset_name,
                         dataset_size, len(mgr.todo))
+            return True
 
     def get_dataset_task(self, node_id: int,
                          dataset_name: str) -> Optional[DatasetTask]:
@@ -193,6 +263,33 @@ class TaskManager:
             return all(
                 now - self._worker_start_task_time.get(nid, now) > timeout
                 for nid in doing_nodes)
+
+    # ------------------------------------------------------- journal replay
+
+    def export_state(self) -> Dict:
+        with self._lock:
+            return {name: mgr.export_state()
+                    for name, mgr in self._datasets.items()}
+
+    def restore_state(self, data: Dict):
+        with self._lock:
+            for name, mgr_data in data.items():
+                self._datasets[name] = DatasetManager.from_state(mgr_data)
+
+    def replay_dispatch(self, dataset_name: str, task_id: int, node_id: int,
+                        start: int, end: int,
+                        indices: Optional[List[int]] = None):
+        with self._lock:
+            mgr = self._datasets.get(dataset_name)
+            if mgr is not None:
+                mgr.replay_dispatch(task_id, node_id, start, end, indices)
+
+    def replay_task_result(self, dataset_name: str, task_id: int,
+                           success: bool):
+        with self._lock:
+            mgr = self._datasets.get(dataset_name)
+            if mgr is not None:
+                mgr.report_task_done(task_id, success)
 
     def get_dataset_checkpoint(self, dataset_name: str) -> str:
         with self._lock:

@@ -507,6 +507,99 @@ class TestBlockingReadback:
         assert found == []
 
 
+class TestRawRpcCall:
+    def test_bare_dial_flagged(self, tmp_path):
+        found = _scan_source(
+            tmp_path, "pkg/agent/probe.py", """\
+            '''Parity: ref.py:1'''
+            import socket
+
+            def ping(addr):
+                host, port = addr.rsplit(":", 1)
+                with socket.create_connection((host, int(port))) as s:
+                    s.sendall(b"hi")
+            """)
+        assert [f.checker for f in found] == ["raw-rpc-call"]
+        assert found[0].line == 6
+
+    def test_sock_connect_flagged(self, tmp_path):
+        found = _scan_source(
+            tmp_path, "pkg/agent/probe.py", """\
+            '''Parity: ref.py:1'''
+            import socket
+
+            def dial(path):
+                sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                sock.connect(path)
+                return sock
+            """)
+        assert [f.checker for f in found] == ["raw-rpc-call"]
+
+    def test_frame_io_outside_comm_flagged(self, tmp_path):
+        found = _scan_source(
+            tmp_path, "pkg/agent/sidechan.py", """\
+            '''Parity: ref.py:1'''
+            from ..common.comm import _send_frame
+
+            def push(sk, data):
+                _send_frame(sk, data)
+            """)
+        assert [f.checker for f in found] == ["raw-rpc-call"]
+
+    def test_dial_under_retry_call_clean(self, tmp_path):
+        """The sanctioned shape: the dial is the retried attempt — any
+        enclosing function routing through retry_call blesses it."""
+        found = _scan_source(
+            tmp_path, "pkg/agent/probe.py", """\
+            '''Parity: ref.py:1'''
+            import socket
+            from ..common.util import retry_call
+
+            def ping(addr):
+                host, port = addr.rsplit(":", 1)
+
+                def attempt():
+                    with socket.create_connection((host, int(port))) as s:
+                        s.sendall(b"hi")
+
+                return retry_call(attempt, attempts=3)
+            """)
+        assert found == []
+
+    def test_comm_module_and_tests_exempt(self, tmp_path):
+        src = """\
+            '''Parity: ref.py:1'''
+            import socket
+
+            def dial(addr):
+                return socket.create_connection(addr)
+            """
+        assert _scan_source(tmp_path, "pkg/common/comm.py", src) == []
+        assert _scan_source(tmp_path, "tests/test_dial.py", src) == []
+
+    def test_non_socket_connect_clean(self, tmp_path):
+        found = _scan_source(
+            tmp_path, "pkg/agent/db.py", """\
+            '''Parity: ref.py:1'''
+            import sqlite3
+
+            def open_db(path):
+                return sqlite3.connect(path)
+            """)
+        assert found == []
+
+    def test_pragma_suppression(self, tmp_path):
+        found = _scan_source(
+            tmp_path, "pkg/agent/probe.py", """\
+            '''Parity: ref.py:1'''
+            import socket
+
+            def ping(addr):
+                return socket.create_connection(addr)  # graftlint: disable=raw-rpc-call
+            """)
+        assert found == []
+
+
 class TestControlPlaneHygiene:
     def test_pickle_on_frame_path_flagged(self, tmp_path):
         found = _scan_source(

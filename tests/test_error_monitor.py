@@ -78,6 +78,32 @@ class TestClassify:
             "Allocation breakdown:\n  buffer 1: 2.0GiB\n  Total: 15.1GiB")
         assert cls == "device_oom"
 
+    # -------- catalogue precedence: first match wins, top to bottom ----
+
+    @pytest.mark.parametrize("text,winner", [
+        # device_oom outranks network even when both patterns match
+        ("RESOURCE_EXHAUSTED: allocation failed, socket buffers full",
+         "device_oom"),
+        # hardware outranks network on a libtpu fault seen over a socket
+        ("libtpu halt: connection reset by interconnect probe", "hardware"),
+        # network outranks preempted when both appear on one line
+        ("DEADLINE_EXCEEDED waiting for SIGTERM drain", "network"),
+        # host_oom (exit 137) outranks the generic preempt/evict class
+        ("exit_code=137 pod evicted by kubelet", "host_oom"),
+    ])
+    def test_pattern_precedence_order(self, text, winner):
+        cls, _, _ = classify_error(text)
+        assert cls == winner
+
+    def test_final_line_pass_outranks_full_text_pass(self):
+        """Pass 1 (catalogue vs final line) must win over pass 3
+        (catalogue vs full text): an OOM traceback whose earlier frames
+        mention the coordinator is still device_oom."""
+        tb = ("connecting to coordinator failed once, retried\n"
+              "XlaRuntimeError: RESOURCE_EXHAUSTED: out of memory")
+        cls, _, _ = classify_error(tb)
+        assert cls == "device_oom"
+
     def test_transient_classes_never_cut_relaunch(self):
         em = ErrorMonitor()
         for pod in (1, 2, 3):
@@ -124,6 +150,43 @@ class TestClassify:
         for pod in (1, 2, 3):
             em.process_error(0, 0, "exit_code=1", node_id=pod)
         assert em.repeated_class(0) is None
+
+    def test_preemption_storm_never_triggers_cutoff(self):
+        """The error_monitor.py comment promises the repeated-class cutoff
+        never fires on preemption-class errors — pin it well past the
+        min_repeats threshold (a capacity crunch can preempt the same rank
+        ten times in a row and relaunching is STILL the right call)."""
+        em = ErrorMonitor()
+        for pod in range(10):
+            em.process_error(3, 0, "SIGTERM: node preempted by scheduler",
+                             node_id=pod)
+        assert em.repeated_class(3) is None
+        assert em.repeated_class(3, min_repeats=2) is None
+        # and the classification itself stays relaunchable
+        _, relaunch = em.process_error(3, 0, "exit_code=143", node_id=99)
+        assert relaunch is True
+
+    def test_network_storm_never_triggers_cutoff(self):
+        """Coordinator blips (master restarts!) are transient by decree:
+        a worker that fails with connection-refused N times while the
+        master recovers must keep its relaunch budget."""
+        em = ErrorMonitor()
+        for pod in range(5):
+            em.process_error(1, 0, "ConnectionRefusedError: [Errno 111]",
+                             node_id=pod)
+        assert em.repeated_class(1) is None
+
+    def test_cutoff_resumes_after_transient_interleave(self):
+        """A transient error BREAKS a hardware streak (set(tail) != 1),
+        but a fresh uninterrupted streak after it still fires."""
+        em = ErrorMonitor()
+        em.process_error(2, 0, "libtpu wedged", node_id=0)
+        em.process_error(2, 0, "libtpu wedged", node_id=1)
+        em.process_error(2, 0, "SIGTERM preempt", node_id=2)
+        assert em.repeated_class(2) is None
+        for pod in (3, 4, 5):
+            em.process_error(2, 0, "libtpu wedged", node_id=pod)
+        assert em.repeated_class(2) == "hardware"
 
 
 class TestIsOomError:

@@ -1,0 +1,556 @@
+"""Master fault tolerance: retry/backoff, journal replay, fencing epochs.
+
+Mirrors reference tests `dlrover/python/tests/test_master_client.py` (retry
+decorator) and `test_servicer.py` style — in-process servers, no cluster —
+extended with the fault shapes the reference never covers because its
+master state dies with the master: refused / half-open / mid-frame-dropped
+connections against RpcClient, idempotent replay of mutating verbs across
+a master restart, epoch-bump re-registration, and the journal's
+snapshot/compaction + torn-tail handling (master/journal.py).
+"""
+
+import json
+import os
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from dlrover_wuqiong_tpu.agent.master_client import MasterClient
+from dlrover_wuqiong_tpu.common import comm, serialize
+from dlrover_wuqiong_tpu.common.messages import (
+    HeartBeat,
+    HeartbeatResponse,
+    NodeMeta,
+    OkResponse,
+)
+from dlrover_wuqiong_tpu.common.util import retry_call
+from dlrover_wuqiong_tpu.master.journal import IdemCache, MasterJournal
+from dlrover_wuqiong_tpu.master.master import JobMaster
+
+
+# --------------------------------------------------------------- retry_call
+
+
+class TestRetryCall:
+    def test_returns_value_and_attempt_budget(self):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise OSError("boom")
+            return 42
+
+        assert retry_call(flaky, attempts=3, base_delay_s=0.0,
+                          jitter=0.0) == 42
+        assert calls["n"] == 3
+
+    def test_exhausted_attempts_reraise(self):
+        calls = {"n": 0}
+
+        def always():
+            calls["n"] += 1
+            raise OSError("down")
+
+        with pytest.raises(OSError):
+            retry_call(always, attempts=3, base_delay_s=0.0, jitter=0.0)
+        assert calls["n"] == 3
+
+    def test_non_retryable_propagates_immediately(self):
+        calls = {"n": 0}
+
+        def wrong():
+            calls["n"] += 1
+            raise ValueError("logic bug")
+
+        with pytest.raises(ValueError):
+            retry_call(wrong, attempts=5, base_delay_s=0.0,
+                       retry_on=(OSError,))
+        assert calls["n"] == 1
+
+    def test_deadline_bounds_wall_clock(self):
+        t0 = time.monotonic()
+        with pytest.raises(OSError):
+            retry_call(lambda: (_ for _ in ()).throw(OSError()),
+                       attempts=None, deadline_s=0.3, base_delay_s=0.05,
+                       max_delay_s=0.1, jitter=0.0)
+        assert time.monotonic() - t0 < 1.5
+
+    def test_backoff_grows_exponentially_and_on_retry_fires(self):
+        delays = []
+        with pytest.raises(OSError):
+            retry_call(lambda: (_ for _ in ()).throw(OSError()),
+                       attempts=4, base_delay_s=0.1, max_delay_s=10.0,
+                       jitter=0.0, sleep=lambda s: None,
+                       on_retry=lambda n, e, d: delays.append(d))
+        assert delays == [0.1, 0.2, 0.4]
+
+
+# --------------------------------------------------- RpcClient under faults
+
+
+def _free_port():
+    return comm.find_free_port()
+
+
+class _ScriptedServer:
+    """TCP stub whose per-connection behavior is scripted: 'refuse' is
+    modeled by not listening at all; 'hang' accepts and never answers;
+    'truncate' sends a torn frame; 'serve' answers like a real master."""
+
+    def __init__(self, behaviors, epoch=1):
+        self.behaviors = list(behaviors)
+        self.epoch = epoch
+        self.served = 0
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(8)
+        self.port = self._sock.getsockname()[1]
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            behavior = self.behaviors.pop(0) if self.behaviors else "serve"
+            threading.Thread(target=self._handle, args=(conn, behavior),
+                             daemon=True).start()
+
+    def _handle(self, conn, behavior):
+        with conn:
+            try:
+                if behavior == "close":
+                    return  # half-open: accepted then dropped pre-read
+                req = comm._recv_frame(conn)  # noqa: SLF001
+                if behavior == "hang":
+                    time.sleep(5.0)
+                    return
+                body = serialize.dumps({"ok": True, "error": "",
+                                        "payload": OkResponse(),
+                                        "epoch": self.epoch})
+                if behavior == "truncate":
+                    # length prefix + half the body, then die mid-frame
+                    conn.sendall(struct.pack(">I", len(body))
+                                 + body[: len(body) // 2])
+                    return
+                comm._send_frame(conn, body)  # noqa: SLF001
+                self.served += 1
+                del req
+            except OSError:
+                return
+
+    def close(self):
+        self._sock.close()
+
+
+class TestRpcClientFaults:
+    def test_connection_refused_bounded_retry(self):
+        port = _free_port()  # nobody listening
+        client = comm.RpcClient(f"127.0.0.1:{port}", retries=3,
+                                base_delay_s=0.01)
+        t0 = time.monotonic()
+        with pytest.raises(comm.MasterUnreachableError):
+            client.get(HeartBeat())
+        assert time.monotonic() - t0 < 5.0  # bounded, no hang
+
+    def test_half_open_connection_recovers(self):
+        """Server accepts then drops the connection twice; third attempt
+        is served — the client must reconnect and succeed."""
+        srv = _ScriptedServer(["close", "close", "serve"])
+        try:
+            client = comm.RpcClient(f"127.0.0.1:{srv.port}", retries=5,
+                                    base_delay_s=0.01)
+            resp = client.get(HeartBeat())
+            assert isinstance(resp, OkResponse)
+            # the server thread increments after replying — poll briefly
+            deadline = time.monotonic() + 2.0
+            while time.monotonic() < deadline and srv.served < 1:
+                time.sleep(0.01)
+            assert srv.served == 1
+        finally:
+            srv.close()
+
+    def test_mid_frame_drop_recovers(self):
+        """A response torn mid-frame (master died while answering) must
+        poison the socket and retry on a fresh connection."""
+        srv = _ScriptedServer(["truncate", "serve"])
+        try:
+            client = comm.RpcClient(f"127.0.0.1:{srv.port}", retries=4,
+                                    base_delay_s=0.01)
+            resp = client.get(HeartBeat())
+            assert isinstance(resp, OkResponse)
+        finally:
+            srv.close()
+
+    def test_rpc_error_never_retried(self):
+        calls = {"n": 0}
+
+        def handler(verb, node_id, node_type, payload):
+            calls["n"] += 1
+            raise ValueError("handler bug")
+
+        server = comm.RpcServer(handler, host="127.0.0.1")
+        server.start()
+        try:
+            client = comm.RpcClient(f"127.0.0.1:{server.port}", retries=5)
+            with pytest.raises(comm.RpcError, match="handler bug"):
+                client.get(HeartBeat())
+            assert calls["n"] == 1  # the master ANSWERED — no blind retry
+        finally:
+            server.stop()
+
+
+# ----------------------------------------------------------- fencing epoch
+
+
+class TestEpochFencing:
+    def test_epoch_change_fires_once_per_bump(self):
+        epoch_cell = {"e": 1}
+        server = comm.RpcServer(lambda *a, **k: OkResponse(),
+                                host="127.0.0.1",
+                                epoch_provider=lambda: epoch_cell["e"])
+        server.start()
+        try:
+            client = comm.RpcClient(f"127.0.0.1:{server.port}")
+            bumps = []
+            client.on_epoch_change = lambda old, new: bumps.append((old,
+                                                                    new))
+            client.get(HeartBeat())
+            assert client.epoch == 1 and bumps == []
+            epoch_cell["e"] = 2
+            client.get(HeartBeat())
+            client.get(HeartBeat())
+            assert client.epoch == 2
+            assert bumps == [(1, 2)]  # once, not per call
+        finally:
+            server.stop()
+
+    def test_master_client_reregisters_and_resyncs_on_bump(self):
+        """An epoch bump must replay the node registration and recent task
+        results (same idem keys) against the new master."""
+        epoch_cell = {"e": 1}
+        seen = {"meta": 0, "results": []}
+
+        def handler(verb, node_id, node_type, payload, idem=None):
+            if isinstance(payload, NodeMeta):
+                seen["meta"] += 1
+            from dlrover_wuqiong_tpu.common.messages import TaskResult
+            if isinstance(payload, TaskResult):
+                seen["results"].append((payload.task_id, idem))
+            if isinstance(payload, HeartBeat):
+                return HeartbeatResponse()
+            return OkResponse()
+
+        server = comm.RpcServer(handler, host="127.0.0.1",
+                                epoch_provider=lambda: epoch_cell["e"])
+        server.start()
+        try:
+            mc = MasterClient(f"127.0.0.1:{server.port}", node_id=0)
+            mc.register_node(node_rank=0)
+            mc.report_task_result("ds", 7)
+            assert seen["meta"] == 1 and len(seen["results"]) == 1
+            epoch_cell["e"] = 2  # "the master restarted"
+            mc.report_heart_beat()
+            assert seen["meta"] == 2  # re-registered
+            # the result re-sync reused the ORIGINAL idem key
+            assert len(seen["results"]) == 2
+            assert seen["results"][0] == seen["results"][1]
+            assert mc.degraded_stats()["reregistrations"] == 1
+        finally:
+            server.stop()
+
+
+# ------------------------------------------------------------ degraded mode
+
+
+class TestDegradedMode:
+    def test_heartbeats_buffer_through_outage_and_drain(self):
+        """Fire-and-forget verbs must not block or raise on a dead master;
+        the buffered frames drain after it returns."""
+        received = []
+
+        def handler(verb, node_id, node_type, payload, idem=None):
+            received.append(type(payload).__name__)
+            return OkResponse()
+
+        port = _free_port()
+        mc = MasterClient(f"127.0.0.1:{port}", node_id=0)
+        t0 = time.monotonic()
+        for step in range(3):
+            resp = mc.report_heart_beat_full(step)  # master is DOWN
+            assert isinstance(resp, HeartbeatResponse)
+        assert time.monotonic() - t0 < 5.0  # never blocked on the outage
+        stats = mc.degraded_stats()
+        assert stats["buffered_total"] == 3 and stats["pending"] == 3
+        # master comes up on the SAME port
+        server = comm.RpcServer(handler, host="127.0.0.1", port=port,
+                                epoch_provider=lambda: 1)
+        server.start()
+        try:
+            mc.report_heart_beat_full(99)  # success → buffer drains
+            stats = mc.degraded_stats()
+            assert stats["pending"] == 0
+            assert stats["flushed_total"] == 3
+            assert len(received) == 4
+        finally:
+            server.stop()
+
+    def test_buffer_is_bounded(self):
+        port = _free_port()
+        mc = MasterClient(f"127.0.0.1:{port}", node_id=0)
+        mc.BUFFER_CAP = 5
+        for step in range(8):
+            mc.report_heart_beat_full(step)
+        stats = mc.degraded_stats()
+        assert stats["pending"] == 5
+        assert stats["dropped_total"] == 3
+
+    def test_kv_store_wait_timeout_carries_epoch(self):
+        server = comm.RpcServer(
+            lambda *a, **k: __import__(
+                "dlrover_wuqiong_tpu.common.messages",
+                fromlist=["KVStoreResponse"]).KVStoreResponse(found=False),
+            host="127.0.0.1", epoch_provider=lambda: 3)
+        server.start()
+        try:
+            mc = MasterClient(f"127.0.0.1:{server.port}", node_id=0)
+            t0 = time.monotonic()
+            with pytest.raises(TimeoutError, match="master epoch=3"):
+                mc.kv_store_wait(["never"], timeout=0.6, poll=0.05)
+            assert time.monotonic() - t0 < 5.0
+        finally:
+            server.stop()
+
+
+# ------------------------------------------------------------- the journal
+
+
+class TestJournal:
+    def test_append_load_roundtrip(self, tmp_path):
+        j = MasterJournal(str(tmp_path))
+        j.load()
+        j.open_epoch()
+        j.append("kv_set", {"key": "a", "value": b"\x00\x01"})
+        j.append("kv_add", {"key": "c", "amount": 2})
+        j.close()
+        j2 = MasterJournal(str(tmp_path))
+        snapshot, entries = j2.load()
+        assert snapshot is None
+        assert [e["kind"] for e in entries] == ["kv_set", "kv_add"]
+        assert entries[0]["data"]["value"] == b"\x00\x01"
+        assert j2.epoch == 1
+        assert j2.open_epoch() == 2
+
+    def test_torn_tail_dropped(self, tmp_path):
+        j = MasterJournal(str(tmp_path))
+        j.load()
+        j.append("kv_add", {"key": "a", "amount": 1})
+        j.append("kv_add", {"key": "a", "amount": 1})
+        j.close()
+        # master SIGKILLed mid-append: torn trailing frame
+        with open(os.path.join(str(tmp_path), "journal.frames"), "ab") as f:
+            f.write(b'{"seq": 99, "kind": "kv_a')
+        j2 = MasterJournal(str(tmp_path))
+        _, entries = j2.load()
+        assert len(entries) == 2  # torn frame dropped, intact ones kept
+
+    def test_snapshot_compacts_and_seq_skips_replayed_prefix(self, tmp_path):
+        j = MasterJournal(str(tmp_path))
+        j.load()
+        j.open_epoch()
+        for i in range(5):
+            j.append("kv_add", {"key": "a", "amount": 1})
+        j.snapshot({"kv": {"a": b"5"}})
+        j.append("kv_add", {"key": "a", "amount": 1})
+        j.close()
+        j2 = MasterJournal(str(tmp_path))
+        snapshot, entries = j2.load()
+        assert snapshot == {"kv": {"a": b"5"}}
+        # only the post-snapshot event replays — the 5 compacted adds are
+        # inside the snapshot (no double-apply)
+        assert len(entries) == 1
+
+    def test_idem_cache_bounded_lru(self):
+        c = IdemCache(cap=3)
+        for i in range(5):
+            c.put(f"k{i}", i)
+        assert len(c) == 3
+        assert c.get("k0") is c.MISS
+        assert c.get("k4") == 4
+
+
+# ----------------------------------------- in-process master restart replay
+
+
+def _client_for(master, node_id=0):
+    return MasterClient(f"127.0.0.1:{master.port}", node_id=node_id)
+
+
+class TestMasterRestartReplay:
+    def test_state_survives_crash_and_epoch_bumps(self, tmp_path):
+        jd = str(tmp_path / "journal")
+        m1 = JobMaster(port=0, journal_dir=jd)
+        m1.prepare()
+        mc = _client_for(m1)
+        mc.report_dataset_shard_params(
+            batch_size=4, dataset_size=64, dataset_name="ds",
+            num_minibatches_per_shard=2)
+        t1 = mc.get_task("ds")
+        t2 = mc.get_task("ds")
+        mc.report_task_result("ds", t1.task_id)
+        mc.kv_store_set("boot", b"coord")
+        assert mc.kv_store_add("counter", 2) == 2
+        mc.join_rendezvous(node_rank=0, local_world_size=1)
+        world = mc.get_comm_world()
+        assert world.complete
+        assert mc.epoch == 1
+        # crash: drop the master with NO clean stop (no final snapshot)
+        m1._server.stop()  # noqa: SLF001
+
+        m2 = JobMaster(port=0, journal_dir=jd)
+        m2.prepare()
+        try:
+            assert m2.epoch == 2
+            mc2 = _client_for(m2)
+            # kv + rendezvous world replayed
+            assert mc2.kv_store_get("boot") == b"coord"
+            assert mc2.kv_store_add("counter", 1) == 3  # cursor exact
+            world2 = mc2.get_comm_world()
+            assert world2.complete
+            assert world2.rdzv_round == world.rdzv_round  # same world, no
+            # re-rendezvous forced by a master-only failure
+            # dispatch state replayed: t2 still in-flight, next task fresh
+            t3 = mc2.get_task("ds")
+            assert t3.task_id not in (t1.task_id, t2.task_id)
+            mgr = m2.task_manager._datasets["ds"]  # noqa: SLF001
+            assert t2.task_id in mgr.doing
+            assert t1.task_id not in mgr.doing  # done stayed done
+        finally:
+            m2.stop()
+
+    def test_idempotent_replay_of_mutating_verbs(self, tmp_path):
+        """A mutating verb acked by master #1 and RETRIED (same idem key)
+        against replayed master #2 must not re-apply."""
+        jd = str(tmp_path / "journal")
+        m1 = JobMaster(port=0, journal_dir=jd)
+        m1.prepare()
+        mc = _client_for(m1)
+        from dlrover_wuqiong_tpu.common.messages import KVStoreAddRequest
+
+        idem = "node0:test:1"
+        resp = mc._client.get(  # noqa: SLF001 — fixed idem on purpose
+            KVStoreAddRequest(key="ct", amount=5), idem=idem)
+        assert resp.num == 5
+        m1._server.stop()  # noqa: SLF001
+
+        m2 = JobMaster(port=0, journal_dir=jd)
+        m2.prepare()
+        try:
+            mc2 = _client_for(m2)
+            # the retry crossing the restart: journaled response replayed,
+            # counter NOT drifted
+            replay = mc2._client.get(  # noqa: SLF001
+                KVStoreAddRequest(key="ct", amount=5), idem=idem)
+            assert replay.num == 5
+            fresh = mc2.kv_store_add("ct", 1)
+            assert fresh == 6  # 5 (+1), not 10 (+1)
+        finally:
+            m2.stop()
+
+    def test_clean_stop_snapshot_then_restart(self, tmp_path):
+        jd = str(tmp_path / "journal")
+        m1 = JobMaster(port=0, journal_dir=jd)
+        m1.prepare()
+        mc = _client_for(m1)
+        mc.kv_store_set("k", b"v")
+        m1.stop()  # clean: compacts into one snapshot frame
+        m2 = JobMaster(port=0, journal_dir=jd)
+        m2.prepare()
+        try:
+            assert m2.epoch == 2
+            assert _client_for(m2).kv_store_get("k") == b"v"
+        finally:
+            m2.stop()
+
+
+# ------------------------------------- subprocess master SIGKILL (tier-1)
+
+
+_MASTER_PROC_SRC = """
+import sys
+from dlrover_wuqiong_tpu.master.master import run_master_forever
+run_master_forever(int(sys.argv[1]), 1, 1, journal_dir=sys.argv[2],
+                   poll_interval=0.2)
+"""
+
+
+class TestSubprocessMasterRestart:
+    def test_sigkill_master_restart_on_same_journal(self, tmp_path):
+        """The fast in-tier-1 shape of the chaos master-kill drill: a real
+        master PROCESS (launched through the subprocess scheduler) is
+        SIGKILLed mid-stream and a successor on the same journal serves
+        the replayed state at a bumped epoch."""
+        from dlrover_wuqiong_tpu.scheduler.base import NodeSpec
+        from dlrover_wuqiong_tpu.scheduler.subprocess_scheduler import (
+            SubprocessSchedulerClient,
+        )
+
+        jd = str(tmp_path / "journal")
+        script = str(tmp_path / "master_main.py")
+        with open(script, "w") as f:
+            f.write(_MASTER_PROC_SRC)
+        port = comm.find_free_port()
+        sched = SubprocessSchedulerClient(log_dir=str(tmp_path / "logs"))
+
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(comm.__file__)))
+        pkg_root = os.path.dirname(repo_root)
+
+        def spawn(node_id):
+            spec = NodeSpec(node_type="master", node_id=node_id,
+                            command=[sys.executable, script, str(port), jd])
+            spec.env["JAX_PLATFORMS"] = "cpu"
+            # the script lives in tmp_path — the package root must be on
+            # the child's path explicitly
+            spec.env["PYTHONPATH"] = pkg_root + os.pathsep + \
+                os.environ.get("PYTHONPATH", "")
+            assert sched.create_node(spec)
+
+        try:
+            spawn(0)
+            deadline = time.time() + 30
+            while time.time() < deadline and \
+                    not comm.addr_connectable(f"127.0.0.1:{port}"):
+                time.sleep(0.1)
+            mc = MasterClient(f"127.0.0.1:{port}", node_id=0,
+                              outage_grace_s=60.0)
+            mc.report_dataset_shard_params(
+                batch_size=2, dataset_size=16, dataset_name="ds",
+                num_minibatches_per_shard=2)
+            t1 = mc.get_task("ds")
+            mc.kv_store_set("k", b"v")
+            assert mc.epoch == 1
+            # SIGKILL — no snapshot, no goodbye
+            proc = sched._procs[("master", 0)]  # noqa: SLF001
+            os.killpg(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=10)
+            spawn(1)  # successor on the same journal + port
+            # the client's own retry rides through the restart window
+            assert mc.kv_store_get("k") == b"v"
+            assert mc.epoch == 2
+            t2 = mc.get_task("ds")
+            assert t2.task_id != t1.task_id  # t1 still in-flight, not
+            # re-dispatched: journal replay was exact
+            assert mc.degraded_stats()["reregistrations"] >= 0
+        finally:
+            sched.close()
