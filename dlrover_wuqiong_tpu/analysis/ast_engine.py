@@ -27,6 +27,12 @@ in the source text, so they are enforced BEFORE a chip is touched:
   driver's one-readback-per-fusion contract (CLAUDE.md dispatch
   amortization; trainer/train_step.py).  Cadence-gated readbacks
   (under an ``if`` — e.g. logging every N steps) are fine.
+- ``unverified-restore`` — raw checkpoint bytes (shm ``load_state_dict``
+  / ``iter_shards``, shard-file ``np.frombuffer``) feeding a restore
+  sink (``restore_pytree`` / ``jax.device_put``) in a function that
+  never calls the verification API (checkpoint/integrity.py): the
+  checkpoint trust boundary digests every shard at save, and a decode
+  path that skips the check hands a flipped bit straight to the device.
 - ``raw-rpc-call``     — a control-plane socket dial
   (``socket.create_connection``, ``*sock*.connect``) or frame-level IO
   (``_send_frame``/``_recv_frame``) outside the retry wrapper: every
@@ -512,6 +518,95 @@ def check_raw_rpc_call(path: str, tree: ast.Module,
     return findings
 
 
+# --------------------------------------------------- unverified-restore
+
+# device-bound restore sinks: these hand bytes to the accelerator (or to
+# the pytree rebuild that feeds device_put)
+RESTORE_SINKS = ("restore_pytree", "device_put")
+# raw checkpoint byte sources: shm segment reads and shard-file decodes —
+# bytes from storage/shm/replica that carry digests which MUST be checked
+RAW_RESTORE_SOURCES = ("load_state_dict", "iter_shards", "frombuffer")
+# the verification API (checkpoint/integrity.py + the engine's verified
+# readers): any of these in the same function sanctions the flow
+RESTORE_VERIFY_CALLS = (
+    "verify", "verify_segment_entries", "verify_segment_blob",
+    "verify_rank_bytes", "verify_meta_bytes", "verify_storage_step",
+    "_load_verified_shm", "_read_verified_step",
+)
+
+
+def check_unverified_restore(path: str, tree: ast.Module,
+                             source_lines: Sequence[str]) -> List[Finding]:
+    """Raw checkpoint bytes reaching a restore sink without verification.
+
+    The checkpoint trust boundary (checkpoint/integrity.py) digests every
+    shard at save; a code path that reads raw bytes (shm
+    ``load_state_dict``/``iter_shards``, shard-file ``np.frombuffer``)
+    AND feeds a restore sink (``restore_pytree``/``jax.device_put``) in
+    the same function, without calling the verification API, would hand
+    a flipped bit or torn persist straight to the device — exactly the
+    silent-restore class the boundary exists to kill.  The sanctioned
+    shape is the engine's: verify in the same function that decodes
+    (``_read_verified_step``), or go through ``engine.load`` which does.
+    Tests are exempt (fault-injection tests read raw bytes on purpose).
+    """
+    parts = path.replace(os.sep, "/").split("/")
+    if "tests" in parts or parts[-1].startswith("test_"):
+        return []
+    findings: List[Finding] = []
+
+    def scope_calls(fn: ast.AST) -> List[ast.Call]:
+        """Calls lexically in `fn`'s own scope (nested defs excluded —
+        they are separate scopes walked on their own)."""
+        out: List[ast.Call] = []
+
+        def visit(node: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    continue
+                if isinstance(child, ast.Call):
+                    out.append(child)
+                visit(child)
+
+        visit(fn)
+        return out
+
+    fns: List[ast.AST] = [tree]
+    fns += [n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    for fn in fns:
+        sinks: List[ast.Call] = []
+        has_source = has_verify = False
+        for node in scope_calls(fn):
+            callee = _terminal_callee(node.func)
+            if callee in RESTORE_SINKS:
+                sinks.append(node)
+            elif callee in RAW_RESTORE_SOURCES:
+                has_source = True
+            elif callee in RESTORE_VERIFY_CALLS:
+                has_verify = True
+        if not (sinks and has_source) or has_verify:
+            continue
+        for call in sinks:
+            if _suppressed(source_lines, call.lineno,
+                           "unverified-restore"):
+                continue
+            callee = _terminal_callee(call.func)
+            findings.append(Finding(
+                "unverified-restore",
+                f"`{callee}(...)` in a function that also decodes raw "
+                f"checkpoint bytes "
+                f"({'/'.join(RAW_RESTORE_SOURCES)}) with no call into "
+                f"the verification API (checkpoint/integrity.py) — a "
+                f"flipped bit or torn persist would reach the device "
+                f"silently; verify digests first or route through "
+                f"engine.load",
+                path, call.lineno,
+                rule="checkpoint bytes are verified before device_put"))
+    return findings
+
+
 # ----------------------------------------------- control-plane-hygiene
 
 
@@ -663,6 +758,8 @@ def run_paths(paths: Sequence[str],
             findings.extend(check_blocking_readback(rel, tree, lines))
         if not checkers or "raw-rpc-call" in checkers:
             findings.extend(check_raw_rpc_call(rel, tree, lines))
+        if not checkers or "unverified-restore" in checkers:
+            findings.extend(check_unverified_restore(rel, tree, lines))
         if not checkers or "control-plane-hygiene" in checkers:
             findings.extend(
                 check_control_plane_hygiene(rel, tree, lines))

@@ -658,6 +658,313 @@ def preempt_warm(total_steps: int = 120, dt: float = 0.05,
     return report
 
 
+# ------------------------------------------------------------- ckpt corrupt
+
+
+_CKPT_CORRUPT_SAVER = r"""
+import os, sys
+import numpy as np
+
+from dlrover_wuqiong_tpu.checkpoint.checkpointer import (
+    FlashCheckpointer, StorageType)
+
+ckpt_dir = sys.argv[1]
+ck = FlashCheckpointer(ckpt_dir, job_name=os.environ["DWT_JOB_NAME"],
+                       standalone=True)
+ck.save_checkpoint(2, {"w": np.full((16, 16), 2.0, np.float32),
+                       "step": np.int64(2)},
+                   storage_type=StorageType.DISK)
+assert ck.wait_latest_checkpoint(60)
+# arm the crash: the NEXT persist hard-exits right after the shard file
+# write, before meta/manifest — the SIGKILL-mid-persist moment
+os.environ["DWT_CKPT_CRASH_POINT"] = "after-bin"
+ck.save_checkpoint(4, {"w": np.full((16, 16), 4.0, np.float32),
+                       "step": np.int64(4)},
+                   storage_type=StorageType.DISK)
+ck.wait_latest_checkpoint(60)  # unreachable: the saver dies mid-persist
+"""
+
+
+def ckpt_corrupt(timeout: float = 180.0) -> Dict:
+    """Checkpoint trust-boundary drill: the full corruption fault matrix.
+
+    Runs a live flash-checkpoint job (engine + in-process async saver +
+    replica ring), commits generations {2, 4, 6}, snapshots the exact
+    expected state, then injects each fault and asserts three invariants
+    per case: (1) zero silent restores — the corruption is DETECTED (it
+    appears in the restore report's fallbacks, or the torn generation is
+    invisible by construction); (2) the restore selects the best healthy
+    tier and the resumed state is BIT-IDENTICAL to the uncorrupted
+    baseline for the step it claims; (3) after a degraded restore the
+    recovered state is re-staged into shm / re-replicated (self-heal),
+    so the next load takes the fast tier again.
+
+    Faults: flipped byte in shm; flipped byte in storage; truncated
+    shard file; missing manifest; stale-generation-only; corrupt replica
+    blob (falls through to storage); SIGKILL mid-persist (subprocess
+    saver hard-killed between shard write and manifest publish — restore
+    falls back to generation N-1 and the doctor flags the torn dir).
+    """
+    import shutil
+
+    import numpy as np
+
+    from .checkpoint.checkpointer import FlashCheckpointer, StorageType
+    from .checkpoint.ckpt_saver import AsyncCheckpointSaver
+    from .checkpoint.integrity import QUARANTINE_DIR
+    from .checkpoint.replica import CkptReplicaManager, ReplicaServer
+
+    work = tempfile.mkdtemp(prefix="dwt-chaos-ckptcorrupt-")
+    os.environ.setdefault("DWT_SOCKET_DIR", "/tmp/dwt/sockets")
+    global _launch_seq
+    _launch_seq += 1
+    job = f"ckc{os.getpid()}n{_launch_seq}"
+    ckpt_dir = os.path.join(work, "ckpt")
+    cases = []
+    report: Dict = {"scenario": "ckpt-corrupt", "cases": cases}
+
+    def expected(step):
+        return {"w": np.full((16, 16), float(step), np.float32),
+                "step": np.int64(step)}
+
+    def resume_step(w):
+        # one deterministic "training step" — bit-identical resume means
+        # this produces byte-equal results from restored vs. baseline
+        import jax
+        import jax.numpy as jnp
+
+        return np.asarray(jax.jit(
+            lambda x: x * jnp.float32(1.0001) + jnp.float32(1.0))(
+                jnp.asarray(w)))
+
+    def check(name, restored, rep, want_step, want_tier, extra_ok=True):
+        exp = expected(want_step)
+        identical = bool(
+            restored is not None
+            and np.array_equal(np.asarray(restored["w"]), exp["w"])
+            and int(restored["step"]) == want_step
+            and np.array_equal(resume_step(restored["w"]),
+                               resume_step(exp["w"])))
+        case = {"fault": name, "tier": rep.get("tier"),
+                "step": rep.get("step"),
+                "fallbacks": rep.get("fallbacks", []),
+                "healed": rep.get("healed", False),
+                "bit_identical": identical,
+                "ok": bool(identical and rep.get("tier") == want_tier
+                           and rep.get("step") == want_step and extra_ok)}
+        cases.append(case)
+        return case["ok"]
+
+    AsyncCheckpointSaver.reset()
+    srv = ReplicaServer()
+    srv.start()
+    template = {"w": np.zeros((16, 16), np.float32), "step": np.int64(0)}
+    mgr = None
+    ck = None
+    try:
+        addr = f"127.0.0.1:{srv.port}"
+        mgr = CkptReplicaManager(rank=0, peers={0: addr, 1: addr},
+                                 job_name=job, replica_count=1)
+        ck = FlashCheckpointer(ckpt_dir, job_name=job, standalone=True,
+                               replica_fetch=mgr.restore)
+        for s in (2, 4, 6):
+            ck.save_checkpoint(s, expected(s),
+                               storage_type=StorageType.DISK)
+            assert ck.wait_latest_checkpoint(60), f"commit of step {s}"
+        mgr.backup()  # peer now holds the verified step-6 segment
+
+        shm = ck.engine._shm_handler  # noqa: SLF001 — drill injects faults
+
+        def flip_shm():
+            buf = shm._buf.buf  # noqa: SLF001
+            buf[1 << 20] = (buf[1 << 20] + 1) % 256
+
+        # --- 1) flipped byte in shm, valid replica -> replica tier serves
+        flip_shm()
+        restored = ck.load_checkpoint(template)
+        rep = ck.last_restore_report
+        ok1 = check("shm-flip->replica", restored, rep, 6, "replica",
+                    extra_ok=any(f["tier"] == "shm"
+                                 for f in rep["fallbacks"]))
+        # self-heal: the fetched segment re-verifies, next load is shm
+        restored = ck.load_checkpoint(template)
+        ok1 = ok1 and ck.last_restore_report["tier"] == "shm"
+        cases[-1]["ok"] = ok1
+
+        # --- 2) flipped byte in shm AND in the replica blob -> storage
+        flip_shm()
+        with srv._lock:  # noqa: SLF001 — corrupt the held backup
+            step6, blob = srv._store[0]
+            bad = bytearray(blob)
+            bad[1 << 20] ^= 0xFF
+            srv._store[0] = (step6, bytes(bad))
+        restored = ck.load_checkpoint(template)
+        rep = ck.last_restore_report
+        check("shm+replica-flip->storage", restored, rep, 6, "storage",
+              extra_ok=(any(f["tier"] == "shm" for f in rep["fallbacks"])
+                        and rep["healed"]))
+
+        # --- 3) flipped byte in the newest storage generation
+        shm.mark_empty()
+        import glob as _glob
+
+        bin6 = _glob.glob(os.path.join(
+            ckpt_dir, "checkpoint-6", "shards_rank*.bin"))[0]
+        raw = bytearray(open(bin6, "rb").read())
+        raw[64] ^= 0x01
+        open(bin6, "wb").write(raw)
+        restored = ck.load_checkpoint(template)
+        rep = ck.last_restore_report
+        qdir = os.path.join(ckpt_dir, QUARANTINE_DIR)
+        check("storage-flip->older-gen", restored, rep, 4, "storage",
+              extra_ok=(any(f.get("step") == 6 and f.get("quarantined")
+                            for f in rep["fallbacks"])
+                        and os.path.isdir(qdir)))
+
+        # --- 4) truncated shard file in the (now newest) generation
+        shm.mark_empty()
+        bin4 = _glob.glob(os.path.join(
+            ckpt_dir, "checkpoint-4", "shards_rank*.bin"))[0]
+        with open(bin4, "rb+") as f:
+            f.truncate(100)
+        restored = ck.load_checkpoint(template)
+        rep = ck.last_restore_report
+        check("truncated-leaf->older-gen", restored, rep, 2, "storage",
+              extra_ok=any(f.get("reason") == "truncated-shard-file"
+                           for f in rep["fallbacks"]))
+
+        # --- 5) missing manifest on a committed generation
+        shm.mark_empty()
+        # rebuild a fresh committed gen 8, then rip its manifest out
+        ck.save_checkpoint(8, expected(8), storage_type=StorageType.DISK)
+        assert ck.wait_latest_checkpoint(60)
+        shm.mark_empty()
+        os.remove(os.path.join(ckpt_dir, "checkpoint-8", "manifest.json"))
+        restored = ck.load_checkpoint(template)
+        rep = ck.last_restore_report
+        check("missing-manifest->older-gen", restored, rep, 2, "storage",
+              extra_ok=any(f.get("reason") == "missing-manifest"
+                           for f in rep["fallbacks"]))
+
+        # --- 6) stale generation only: tracker names a vanished gen,
+        # only an OLDER committed generation survives on storage
+        shm.mark_empty()
+        shutil.rmtree(os.path.join(ckpt_dir, "checkpoint-2"))
+        ck.save_checkpoint(1, expected(1), storage_type=StorageType.DISK)
+        # wait on the generation's OWN manifest: the tracker still says 2
+        # (repointed by the earlier quarantine), so the step-agnostic
+        # wait_latest_checkpoint would return before the persist lands
+        manifest1 = os.path.join(ckpt_dir, "checkpoint-1", "manifest.json")
+        deadline = time.time() + 60
+        while not os.path.exists(manifest1) and time.time() < deadline:
+            time.sleep(0.05)
+        assert os.path.exists(manifest1), "step-1 persist never committed"
+        shm.mark_empty()
+        from .common.constants import CheckpointConstant
+
+        with open(os.path.join(ckpt_dir,
+                               CheckpointConstant.TRACKER_FILE), "w") as f:
+            f.write("2")  # retention ate checkpoint-2; tracker is stale
+        restored = ck.load_checkpoint(template)
+        rep = ck.last_restore_report
+        check("stale-generation-only", restored, rep, 1, "storage",
+              extra_ok=any(f.get("reason") == "missing-generation"
+                           for f in rep["fallbacks"]))
+    finally:
+        if ck is not None:
+            try:
+                ck.close()
+            except Exception:  # noqa: BLE001
+                pass
+        AsyncCheckpointSaver.reset()
+        if mgr is not None:
+            mgr.close()
+        srv.stop()
+
+    # --- 7) SIGKILL mid-persist (subprocess saver, crash between shard
+    # write and manifest publish) -> restore serves generation N-1
+    sub_work = os.path.join(work, "midpersist")
+    os.makedirs(sub_work)
+    sub_ckpt = os.path.join(sub_work, "ckpt")
+    _launch_seq += 1
+    sub_job = f"ckm{os.getpid()}n{_launch_seq}"
+    env = dict(os.environ, DWT_JOB_NAME=sub_job, JAX_PLATFORMS="cpu",
+               DWT_SOCKET_DIR=os.path.join(sub_work, "sockets"),
+               PYTHONPATH=os.path.dirname(os.path.dirname(
+                   os.path.abspath(__file__))) + os.pathsep +
+               os.environ.get("PYTHONPATH", ""))
+    script = os.path.join(sub_work, "saver.py")
+    with open(script, "w") as f:
+        f.write(_CKPT_CORRUPT_SAVER)
+    proc = subprocess.run([sys.executable, script, sub_ckpt], env=env,
+                          cwd=sub_work, stdout=subprocess.PIPE,
+                          stderr=subprocess.STDOUT, text=True,
+                          timeout=timeout)
+    AsyncCheckpointSaver.reset()
+    _launch_seq += 1
+    verify_job = f"ckv{os.getpid()}n{_launch_seq}"
+    ck2 = FlashCheckpointer(sub_ckpt, job_name=verify_job,
+                            standalone=True)
+    try:
+        restored = ck2.load_checkpoint(
+            {"w": np.zeros((16, 16), np.float32), "step": np.int64(0)})
+        rep = ck2.last_restore_report
+        # the dead saver's shm segment must have been reaped on startup
+        # (stale-segment sweeper) — its creator pid is gone
+        swept = not os.path.exists(f"/dev/shm/{sub_job}_ckpt_shm_0")
+        torn_dir = os.path.join(sub_ckpt, "checkpoint-4")
+        torn_detectable = (os.path.isdir(torn_dir) and not os.path.exists(
+            os.path.join(torn_dir, "manifest.json")))
+        identical = bool(
+            restored is not None
+            and np.array_equal(np.asarray(restored["w"]),
+                               np.full((16, 16), 2.0, np.float32))
+            and int(restored["step"]) == 2)
+        cases.append({
+            "fault": "sigkill-mid-persist", "tier": rep.get("tier"),
+            "step": rep.get("step"), "saver_rc": proc.returncode,
+            "bit_identical": identical, "swept_stale_shm": swept,
+            "torn_gen_detectable": torn_detectable,
+            "ok": bool(proc.returncode == 137 and identical
+                       and rep.get("step") == 2 and swept
+                       and torn_detectable)})
+    finally:
+        ck2.close()
+        AsyncCheckpointSaver.reset()
+
+    # the doctor must independently flag the torn generation
+    import json as _json
+
+    doctor = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "ckpt_doctor.py"),
+         sub_ckpt], stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, timeout=60)
+    try:
+        verdict = _json.loads(doctor.stdout.strip().splitlines()[-1])
+        bad = [g for g in verdict["ckpt_doctor"]["generations"]
+               if not g["ok"]]
+        report["doctor"] = {"rc": doctor.returncode,
+                            "flagged_steps": [g["step"] for g in bad]}
+        doctor_ok = doctor.returncode == 1 and any(
+            g["step"] == 4 for g in bad)
+    except (ValueError, KeyError, IndexError):
+        report["doctor"] = {"rc": doctor.returncode, "parse": "failed"}
+        doctor_ok = False
+
+    report["silent_restores"] = sum(
+        1 for c in cases if not c.get("bit_identical"))
+    report["ok"] = bool(all(c["ok"] for c in cases) and doctor_ok
+                        and len(cases) == 7)
+    if report["ok"]:
+        shutil.rmtree(work, ignore_errors=True)
+    else:
+        report["workdir"] = work
+        if proc.stdout:
+            report["saver_tail"] = proc.stdout[-1500:]
+    return report
+
+
 # -------------------------------------------------------------- master kill
 
 
@@ -897,6 +1204,7 @@ SCENARIOS = {"pod-kill": pod_kill, "straggler": straggler,
              "preempt": preempt, "preempt-table": preempt_table,
              "preempt-warm": preempt_warm,
              "preempt-fused": preempt_fused,
+             "ckpt-corrupt": ckpt_corrupt,
              "master-kill": master_kill}
 
 

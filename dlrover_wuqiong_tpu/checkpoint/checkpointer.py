@@ -37,17 +37,31 @@ class FlashCheckpointer:
                  job_name: str = "dwt", node_rank: int = 0,
                  local_shard_num: int = 1,
                  standalone: Optional[bool] = None,
-                 wire_dtype: Optional[str] = None):
+                 wire_dtype: Optional[str] = None,
+                 replica_fetch=None):
         """`wire_dtype="bf16"` halves checkpoint bytes end to end (D2H
         staging, disk, restore H2D) by narrowing f32 leaves to bf16 on
         device; restore upcasts back on device.  NOT bit-exact for f32
         state (bf16/int leaves round-trip exactly) — for transfer-bound
-        links where restore latency beats the last 16 mantissa bits."""
+        links where restore latency beats the last 16 mantissa bits.
+
+        `replica_fetch`: optional callable pulling this rank's staged
+        segment from a peer replica holder into local shm (the engine
+        tries it when the local segment fails verification — the middle
+        tier of the verified restore chain)."""
         self.engine = CheckpointEngine(
             checkpoint_dir, local_rank=local_rank, job_name=job_name,
             node_rank=node_rank, local_shard_num=local_shard_num,
-            standalone=standalone, wire_dtype=wire_dtype)
+            standalone=standalone, wire_dtype=wire_dtype,
+            replica_fetch=replica_fetch)
         self.checkpoint_dir = checkpoint_dir
+
+    @property
+    def last_restore_report(self) -> Dict:
+        """Which tier/generation served the last load, every fallback
+        taken (with quarantine paths), and whether self-heal re-staged
+        shm — {} before any load."""
+        return self.engine.last_restore
 
     def save_checkpoint(self, step: int, state: Any,
                         storage_type: StorageType = StorageType.DISK,

@@ -12,10 +12,21 @@ tracker file.  On worker failure the agent calls `save_shm_to_storage` so the
 last in-memory checkpoint survives the restart.
 
 Directory layout per step:
-    {path}/checkpoint-{step}/meta_rank{r}.json
+    {path}/checkpoint-{step}/meta_rank{r}.json       (per-leaf digests)
     {path}/checkpoint-{step}/shards_rank{r}.bin
     {path}/checkpoint-{step}/.done/rank{r}.done
-    {path}/latest_checkpointed_iteration.txt         (commit marker)
+    {path}/checkpoint-{step}/manifest.json           (integrity commit)
+    {path}/checkpoint-{step}/.commit                 (marker)
+    {path}/latest_checkpointed_iteration.txt         (tracker)
+
+Trust boundary (checkpoint/integrity.py): every shard's bytes are
+digested while streaming out of shm — a mismatch against the staged
+digest ABORTS the persist (a bit flip in the segment must not become a
+committed generation).  The commit then publishes, in order: done-files →
+manifest.json (per-rank file digests, step, world shape, atomic
+write-tmp+fsync+rename) → .commit marker → tracker.  A crash anywhere in
+that sequence leaves a generation that is detectably torn (marker without
+manifest, manifest whose digests miss) and therefore never restored.
 """
 
 from __future__ import annotations
@@ -31,9 +42,24 @@ from ..common.constants import CheckpointConstant
 from ..common.log import get_logger
 from ..common.multi_process import SharedLock, SharedQueue
 from ..common.storage import CheckpointStorage, get_checkpoint_storage
-from .shm_handler import SharedMemoryHandler
+from .integrity import DIGEST_ALGO, build_manifest, digest_bytes, \
+    write_manifest
+from .shm_handler import SharedMemoryHandler, sweep_stale_segments
 
 logger = get_logger("ckpt_saver")
+
+# fault-injection hook for the SIGKILL-mid-persist drill/tests: the saver
+# hard-exits (os._exit — no cleanup, same as a SIGKILL landing there) at
+# the named point.  Values: "after-bin" (shard file written, no meta/done),
+# "before-manifest" (done-files written, manifest not yet).  Only ever set
+# by tests/chaos subprocesses.
+_CRASH_POINT_ENV = "DWT_CKPT_CRASH_POINT"
+
+
+def _maybe_crash(point: str):
+    if os.getenv(_CRASH_POINT_ENV) == point:
+        logger.error("fault injection: hard-exit at %s", point)
+        os._exit(137)
 
 _SAVE_EVENT = "save"
 _UPDATE_SHARDS_EVENT = "update_shards"
@@ -113,6 +139,12 @@ class AsyncCheckpointSaver:
         # done-file, not just this node's (reference ckpt_saver.py:863)
         self.world_shard_num = world_shard_num or local_shard_num
         self.storage = storage or get_checkpoint_storage()
+        # hard-killed runs leak their POSIX segments until reboot — reap
+        # the ones whose creator pid is dead before allocating our own
+        try:
+            sweep_stale_segments(job_name)
+        except Exception:  # noqa: BLE001 — sweeping must never block startup
+            logger.exception("stale shm sweep failed")
         self._event_queue = SharedQueue(f"{job_name}-ckpt-events", master=True)
         self._shm_handlers: Dict[int, SharedMemoryHandler] = {
             r: SharedMemoryHandler(r, job_name)
@@ -348,13 +380,34 @@ class AsyncCheckpointSaver:
         metas_out: List[Dict] = []
         from ..common.storage import PosixDiskStorage
 
+        # digest-while-streaming: each shard's bytes are checked against
+        # the digest staged with them; a mismatch means the segment was
+        # corrupted AFTER staging (bit flip, torn concurrent write) and
+        # the persist ABORTS — a corrupt generation must never commit
+        bin_digest = 0
+        offset = 0
+
+        def _digest_view(meta, view) -> bool:
+            nonlocal bin_digest
+            chunk = bytes(view)
+            if meta.digest is not None and int(meta.digest) >= 0 and \
+                    digest_bytes(chunk) != int(meta.digest):
+                logger.error(
+                    "shm shard %s of step %d fails its staged digest — "
+                    "aborting persist (segment corrupted after staging)",
+                    meta.name, step)
+                return False
+            bin_digest = digest_bytes(chunk, bin_digest)
+            return True
+
         if isinstance(self.storage, PosixDiskStorage):
             # fast path: stream shm → file with an atomic rename commit
             tmp = f"{bin_path}.tmp.{os.getpid()}"
             os.makedirs(os.path.dirname(bin_path), exist_ok=True)
-            offset = 0
             with open(tmp, "wb") as f:
                 for meta, view in handler.iter_shards():
+                    if not _digest_view(meta, view):
+                        return False
                     f.write(view)
                     d = meta.to_dict()
                     d["file_offset"] = offset
@@ -369,8 +422,9 @@ class AsyncCheckpointSaver:
             # shard set; commit-by-done-file keeps atomicity (object writes
             # are already atomic)
             views = []
-            offset = 0
             for meta, view in handler.iter_shards():
+                if not _digest_view(meta, view):
+                    return False
                 views.append(view)
                 d = meta.to_dict()
                 d["file_offset"] = offset
@@ -378,8 +432,12 @@ class AsyncCheckpointSaver:
                 metas_out.append(d)
             self.storage.write_fileobj(_ViewsReader(views), bin_path,
                                        offset)
+        _maybe_crash("after-bin")
         self.storage.write(json.dumps({
             "step": step,
+            "algo": DIGEST_ALGO,
+            "bin_nbytes": offset,
+            "bin_digest": bin_digest,
             "extra": header.get("extra", {}),
             "tensors": metas_out,
         }), meta_path)
@@ -409,6 +467,14 @@ class AsyncCheckpointSaver:
         deadline = time.time() + timeout
         while time.time() < deadline:
             if len(self.storage.listdir(done_dir)) >= expected:
+                _maybe_crash("before-manifest")
+                # commit order: manifest (digests over everything) →
+                # marker → tracker.  Each is an atomic publish; a crash
+                # between any two leaves a generation that is detectably
+                # torn (marker implies manifest; tracker implies marker),
+                # never a silently-restorable one.
+                if not self._write_step_manifest(step, sdir):
+                    return False
                 # marker BEFORE tracker: a step is only selectable by
                 # rollback's committed_steps() once every shard landed —
                 # done-files alone can be a partial set (crash mid-flush)
@@ -423,6 +489,51 @@ class AsyncCheckpointSaver:
         logger.error("commit timeout for step %d (%d/%d done)", step,
                      len(self.storage.listdir(done_dir)), expected)
         return False
+
+    def _write_step_manifest(self, step: int, sdir: str) -> bool:
+        """Aggregate every rank's meta into the generation manifest.
+
+        Per-rank shard-file digests come from the meta jsons (each saver
+        computed its own while streaming); the manifest seals the metas
+        themselves with a digest of their bytes, so any later bit flip —
+        in a shard file OR in a meta — breaks the chain."""
+        ranks: Dict[int, Dict] = {}
+        extra: Dict = {}
+        for fname in self.storage.listdir(sdir):
+            if not (fname.startswith("meta_rank")
+                    and fname.endswith(".json")):
+                continue
+            rank = int(fname[len("meta_rank"):-len(".json")])
+            raw = self.storage.read(os.path.join(sdir, fname))
+            if raw is None:
+                logger.error("commit of step %d: meta for rank %d "
+                             "vanished", step, rank)
+                return False
+            raw = raw.encode() if isinstance(raw, str) else bytes(raw)
+            try:
+                meta = json.loads(raw.decode())
+            except ValueError:
+                logger.error("commit of step %d: meta for rank %d is "
+                             "torn", step, rank)
+                return False
+            ranks[rank] = {
+                "bin_nbytes": int(meta.get("bin_nbytes", -1)),
+                "bin_digest": int(meta.get("bin_digest", -1)),
+                "meta_digest": digest_bytes(raw),
+                "n_tensors": len(meta.get("tensors", [])),
+            }
+            extra = extra or meta.get("extra", {})
+        if not ranks:
+            logger.error("commit of step %d: no rank metas found", step)
+            return False
+        manifest = build_manifest(
+            step, ranks,
+            world={"world_shard_num": self.world_shard_num,
+                   "local_shard_num": self.local_shard_num,
+                   "node_rank": self.node_rank},
+            extra=extra)
+        write_manifest(self.storage, sdir, manifest)
+        return True
 
     # ------------------------------------------------------- failure handling
 

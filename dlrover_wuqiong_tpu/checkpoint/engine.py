@@ -36,14 +36,31 @@ from ..common.storage import CheckpointStorage, get_checkpoint_storage
 from .ckpt_saver import (
     AsyncCheckpointSaver,
     CheckpointEvent,
-    load_step_metas,
     read_last_step,
     shm_lock_name,
     step_dir,
 )
+from .integrity import (
+    VerifyFailure,
+    quarantine_step,
+    read_manifest,
+    verify_meta_bytes,
+    verify_rank_bytes,
+    verify_segment_entries,
+)
 from .shm_handler import SharedMemoryHandler, _np_dtype, flatten_state_dict
 
 logger = get_logger("ckpt_engine")
+
+# fallback reasons that mean CORRUPTION (reported to the master as
+# checkpoint-health events) vs. benign tier misses (cold shm, a segment
+# from another job/step, a single rank of a multi-process world)
+_BENIGN_REASONS = ("stale", "foreign-segment", "step-mismatch",
+                   "partial-local-coverage")
+
+
+def _is_corruption(reason: str) -> bool:
+    return bool(reason) and reason not in _BENIGN_REASONS
 
 
 class CheckpointEngine:
@@ -51,7 +68,8 @@ class CheckpointEngine:
                  job_name: str = "dwt", standalone: Optional[bool] = None,
                  storage: Optional[CheckpointStorage] = None,
                  local_shard_num: int = 1, node_rank: int = 0,
-                 wire_dtype: Optional[str] = None):
+                 wire_dtype: Optional[str] = None,
+                 replica_fetch=None):
         """`wire_dtype="bf16"`: f32 float leaves are cast to bf16 ON
         DEVICE during the snapshot — halving D2H staging, disk bytes, and
         restore H2D (restore upcasts on device).  NOT bit-exact for f32
@@ -97,6 +115,18 @@ class CheckpointEngine:
         # overwrite the payload while the saver streams it to disk
         self._shm_lock = SharedLock(shm_lock_name(job_name, local_rank),
                                     master=False)
+        # verified tiered restore (checkpoint/integrity.py): optional
+        # callable that pulls this rank's segment from a peer replica
+        # holder into local shm (agent wires CkptReplicaManager.restore);
+        # tried when the local segment fails verification
+        self.replica_fetch = replica_fetch
+        # invoked with the restored step after a DEGRADED restore (a tier
+        # other than local shm served it) — the agent hangs re-replication
+        # here so the next failure doesn't pay the slow path again
+        self.on_degraded_restore = None
+        # report of the last load(): which tier/generation served, every
+        # fallback taken and why, whether self-heal re-staged shm
+        self.last_restore: Dict = {}
 
     def _stage_locked(self, state: Any, step: int, extra: Dict):
         acquired = False
@@ -217,6 +247,28 @@ class CheckpointEngine:
         self._record_blocking_metric(blocked)
         return blocked
 
+    def _report_ckpt_health(self, tier: str, reason: str):
+        """Checkpoint-health event: local metric + master node event.
+
+        The master's event stream is where operators see corruption —
+        a quarantined generation on one node of a large job would
+        otherwise only exist in that node's logs."""
+        try:
+            from ..master.metrics import get_registry
+
+            get_registry().inc(
+                "dwt_ckpt_integrity_events",
+                labels={"job": self.job_name, "tier": tier},
+                help="checkpoint verification failures/degraded restores")
+            from ..trainer import elastic as _elastic
+
+            ctx = getattr(_elastic, "_context", None)
+            if ctx is not None and ctx.mc is not None:
+                ctx.mc.report_node_event(
+                    "ckpt-health", f"{tier}: {reason}", level="warning")
+        except Exception:  # noqa: BLE001 — health reporting must never
+            pass           # break a restore
+
     def _record_blocking_metric(self, blocked: float):
         """Local registry + forward to the master (whose /metrics endpoint
         is the one operators scrape — the worker's registry is per-process
@@ -279,29 +331,156 @@ class CheckpointEngine:
 
     def load(self, path: Optional[str] = None,
              step: Optional[int] = None) -> Optional[Dict[str, np.ndarray]]:
-        """Load flat {name: np.ndarray} — from shm if fresh, else storage.
+        """Verified tiered restore → flat {name: np.ndarray}.
 
-        Names containing ``#shardN`` are assembled into full global arrays.
+        Walks shm segment → peer replica fetch → storage generations
+        (newest committed first), digest-verifying each tier BEFORE any
+        bytes are assembled or reach ``device_put`` — a flipped byte, torn
+        persist, or truncated shard can never be silently restored.  A
+        storage generation that fails verification is QUARANTINED to the
+        ``.quarantine/`` sidecar (evidence, not deletion) and the walk
+        continues to the next-older commit.  After a degraded restore
+        (any tier but local shm) the recovered state is re-staged into
+        shm (self-heal) so the next failure takes the fast path again.
+        ``self.last_restore`` reports which tier/generation served and
+        every fallback taken.  Names containing ``#shardN`` are assembled
+        into full global arrays.
         """
         self._wait_drain()  # an in-flight staging must land before reading
-        shm = self._shm_handler.load_state_dict()
-        if shm is not None and (step is None or shm[0] == step):
-            shm_step, flat, metas, extra = shm
-            entries = [dict(m.to_dict(), array=flat[m.name]) for m in metas]
+        path = path or self.checkpoint_dir
+        report: Dict = {"tier": "none", "step": -1, "fallbacks": [],
+                        "healed": False}
+        self.last_restore = report
+
+        stale_shm = None  # verified shm OLDER than the storage tracker:
+        # kept as a candidate in case the newer storage gens are corrupt
+        flat, shm_step, reason = self._load_verified_shm(path, step)
+        if flat is not None:
+            if step is not None or shm_step >= read_last_step(
+                    path, self.storage):
+                report.update(tier="shm", step=shm_step)
+                return flat
+            stale_shm = (shm_step, flat)
+            reason = "stale"
+        if reason:
+            report["fallbacks"].append({"tier": "shm", "reason": reason})
+            if _is_corruption(reason):
+                self._report_ckpt_health("shm", reason)
+
+        # replica tier: pull my segment from a peer holder into shm
+        # (replica.py digest-checks the blob before it touches the
+        # segment), then re-verify end to end
+        if stale_shm is None and self.replica_fetch is not None:
+            try:
+                fetched = self.replica_fetch()
+            except Exception:  # noqa: BLE001 — replica tier is best-effort
+                logger.exception("replica fetch failed")
+                fetched = None
+            if fetched is not None:
+                flat, shm_step, reason = self._load_verified_shm(path, step)
+                if flat is not None and (
+                        step is not None or shm_step >= read_last_step(
+                            path, self.storage)):
+                    report.update(tier="replica", step=shm_step)
+                    self._finish_degraded(flat, shm_step, path, report,
+                                          restage=False)
+                    return flat
+                if flat is not None:
+                    stale_shm = (shm_step, flat)
+                    reason = "stale"
+                if reason:
+                    report["fallbacks"].append({"tier": "replica",
+                                                "reason": reason})
+                    if _is_corruption(reason):
+                        self._report_ckpt_health("replica", reason)
+
+        flat = self.load_from_storage(path, step, _report=report)
+        if flat is not None:
+            if stale_shm is not None and stale_shm[0] > report["step"]:
+                # every storage gen newer than the stale shm was corrupt:
+                # the verified shm staging is now the best copy there is
+                report.update(tier="shm", step=stale_shm[0])
+                return stale_shm[1]
+            # multi-process world (local shm legitimately holds only this
+            # process's shards): restaging the ASSEMBLED global state
+            # would blow local shm up to full-model size — skip the heal,
+            # the next save re-stages the right shards
+            restage = not any(f.get("reason") == "partial-local-coverage"
+                              for f in report["fallbacks"])
+            self._finish_degraded(flat, report["step"], path, report,
+                                  restage=restage)
+            return flat
+        if stale_shm is not None:
+            report.update(tier="shm", step=stale_shm[0])
+            return stale_shm[1]
+        return None
+
+    def _load_verified_shm(self, path: str, step: Optional[int]
+                           ) -> tuple:
+        """(flat, step, reason) — flat None unless the local segment is
+        present, tagged for `path`, digest-verified, step-matched, and
+        fully covering.  `reason` explains a None (None reason = simply
+        no segment staged)."""
+        state = self._shm_handler.segment_state()
+        if state in ("absent", "empty"):
+            return None, -1, None
+        if state == "torn":
+            return None, -1, "torn-header"
+        loaded = self._shm_handler.load_state_dict()
+        if loaded is None:  # raced a concurrent invalidation
+            return None, -1, None
+        shm_step, flat, metas, extra = loaded
+        if extra.get("_ckpt_dir") != path:
             # no tag (legacy/foreign segment) must NOT pass the guard
-            shm_dir = extra.get("_ckpt_dir")
-            if shm_dir != (path or self.checkpoint_dir):
-                shm = None  # stale segment from a different job run
-            elif not self._full_coverage(entries):
-                # multi-process world: local shm holds only THIS process's
-                # shards — assembling would fill peer shards with garbage
-                # (and each process would restore different values).
-                # Storage has every rank's shards.
-                shm = None
-            elif step is not None or shm_step >= read_last_step(
-                    path or self.checkpoint_dir, self.storage):
-                return self._assemble(entries)
-        return self.load_from_storage(path, step)
+            return None, -1, "foreign-segment"
+        if step is not None and shm_step != step:
+            return None, -1, "step-mismatch"
+        header = self._shm_handler.load_header() or {}
+        ok, why = verify_segment_entries(metas, flat,
+                                         header.get("algo", ""))
+        if not ok:
+            logger.error("shm segment for step %d fails verification "
+                         "(%s) — falling back", shm_step, why)
+            return None, -1, why
+        entries = [dict(m.to_dict(), array=flat[m.name]) for m in metas]
+        if not self._full_coverage(entries):
+            # multi-process world: local shm holds only THIS process's
+            # shards — assembling would fill peer shards with garbage
+            # (and each process would restore different values).
+            # Storage has every rank's shards.
+            return None, -1, "partial-local-coverage"
+        return self._assemble(entries), shm_step, None
+
+    def _finish_degraded(self, flat: Dict, step: int, path: str,
+                         report: Dict, restage: bool):
+        """Self-heal after a degraded restore: re-stage the recovered
+        state into shm (so the NEXT failure reads the fast tier) and let
+        the wiring re-replicate it to peers."""
+        if restage:
+            try:
+                self._stage_locked(flat, step, {"_ckpt_dir": path})
+                ok, why = self._shm_handler.verify()
+                report["healed"] = bool(ok)
+                if not ok:
+                    logger.warning("self-heal restage failed "
+                                   "verification: %s", why)
+            except Exception:  # noqa: BLE001 — healing must not break restore
+                logger.exception("self-heal restage failed")
+        else:
+            report["healed"] = True  # replica fetch already filled shm
+        self._latest_step = max(self._latest_step, step)
+        if self.on_degraded_restore is not None:
+            try:
+                self.on_degraded_restore(step)
+            except Exception:  # noqa: BLE001
+                logger.exception("on_degraded_restore hook failed")
+        logger.warning(
+            "DEGRADED restore: tier=%s step=%d fallbacks=%s healed=%s",
+            report["tier"], step, report["fallbacks"], report["healed"])
+        self._report_ckpt_health(
+            "degraded-restore",
+            f"tier={report['tier']} step={step} "
+            f"fallbacks={len(report['fallbacks'])}")
 
     @staticmethod
     def _full_coverage(entries) -> bool:
@@ -323,24 +502,104 @@ class CheckpointEngine:
         return all(vol.get(b, 0) >= math.prod(gs) for b, gs in glob.items())
 
     def load_from_storage(self, path: Optional[str] = None,
-                          step: Optional[int] = None
+                          step: Optional[int] = None,
+                          _report: Optional[Dict] = None
                           ) -> Optional[Dict[str, np.ndarray]]:
+        """Verified walk over committed generations, newest first.
+
+        Explicit `step`: that generation only — a verification failure
+        quarantines it and returns None (the caller asked for THOSE
+        bytes; substituting another step silently would be worse than
+        failing).  `step=None`: newest-first over every committed
+        generation, quarantining failures and falling back until one
+        verifies.  `_report` (engine-internal) collects tier/fallbacks.
+        """
         path = path or self.checkpoint_dir
-        if step is None:
-            step = read_last_step(path, self.storage)
-        if step < 0:
-            return None
-        rank_metas = load_step_metas(path, step, self.storage)
-        if not rank_metas:
-            return None
+        report = _report if _report is not None else {
+            "tier": "none", "step": -1, "fallbacks": [], "healed": False}
+        if _report is None:
+            self.last_restore = report
+        if step is not None:
+            candidates = [step]
+        else:
+            tracker = read_last_step(path, self.storage)
+            candidates = sorted(
+                set(self.committed_steps(path))
+                | ({tracker} if tracker >= 0 else set()),
+                reverse=True)
+        for s in candidates:
+            flat, failure = self._read_verified_step(path, s)
+            if flat is not None:
+                report.update(tier="storage", step=s)
+                if step is None and s != candidates[0]:
+                    logger.warning(
+                        "restored OLDER generation %d (newest committed "
+                        "was %d) — newer generations failed verification",
+                        s, candidates[0])
+                if step is None and report["fallbacks"] and \
+                        read_last_step(path, self.storage) > s:
+                    # the tracker's target was just quarantined: repoint
+                    # it at the generation that actually verified, so
+                    # later loads (and freshness comparisons against the
+                    # healed shm staging) converge instead of re-walking
+                    self.storage.write(str(s), os.path.join(
+                        path, CheckpointConstant.TRACKER_FILE))
+                return flat
+            if failure is None:
+                continue  # nothing (or an in-progress persist) there
+            # verification failed: quarantine the evidence, walk on
+            qdir = quarantine_step(self.storage, path, s, failure)
+            report["fallbacks"].append(
+                {"tier": "storage", "step": s, "reason": failure,
+                 "quarantined": qdir})
+            self._report_ckpt_health("storage", f"step {s}: {failure}")
+        return None
+
+    def _read_verified_step(self, path: str, step: int) -> tuple:
+        """(flat, failure_reason): digest-verified read of one generation.
+
+        (None, None) = generation absent / not yet committed (benign);
+        (None, reason) = bytes present but fail the trust boundary.
+        """
+        sdir = step_dir(path, step)
+        manifest = read_manifest(self.storage, sdir)
+        if manifest is None:
+            if not self.storage.exists(sdir):
+                if read_last_step(path, self.storage) == step:
+                    # the tracker names a generation that no longer
+                    # exists at all — data loss, not an in-flight save
+                    return None, "missing-generation"
+                return None, None
+            marker = os.path.join(sdir, CheckpointConstant.COMMIT_MARKER)
+            tracker_step = read_last_step(path, self.storage)
+            if self.storage.exists(marker) or tracker_step == step:
+                # committed (or tracker-published) without a manifest:
+                # a torn/ripped-out manifest, or a pre-trust-boundary
+                # writer — unverifiable either way
+                return None, "missing-manifest"
+            return None, None  # persist still in flight — not ours to touch
+        if int(manifest.get("step", -1)) != step:
+            return None, "manifest-step-mismatch"
+        algo = manifest.get("algo", "")
         entries = []
-        for rank, meta in rank_metas.items():
-            sdir = step_dir(path, step)
-            bin_path = os.path.join(sdir, f"shards_rank{rank}.bin")
-            raw = self.storage.read(bin_path)
-            if raw is None:
-                logger.error("missing shard file %s", bin_path)
-                return None
+        for rank_s, entry in manifest["ranks"].items():
+            rank = int(rank_s)
+            meta_raw = self.storage.read(
+                os.path.join(sdir, f"meta_rank{rank}.json"))
+            raw = self.storage.read(
+                os.path.join(sdir, f"shards_rank{rank}.bin"))
+            if meta_raw is None or raw is None:
+                return None, "missing-shard-file"
+            meta_raw = (meta_raw.encode() if isinstance(meta_raw, str)
+                        else bytes(meta_raw))
+            raw = bytes(raw)
+            try:
+                meta = verify_meta_bytes(meta_raw, entry, algo, rank)
+                verify_rank_bytes(raw, entry, algo, rank)
+            except VerifyFailure as e:
+                logger.error("step %d rank %d fails verification: %s",
+                             step, rank, e)
+                return None, e.reason
             for t in meta["tensors"]:
                 arr = np.frombuffer(
                     raw, dtype=_np_dtype(t["dtype"]),
@@ -352,8 +611,8 @@ class CheckpointEngine:
             # would fill the holes with uninitialized memory
             logger.error("step %d on storage is missing shards — refusing "
                          "to assemble a partial checkpoint", step)
-            return None
-        return self._assemble(entries)
+            return None, "partial-coverage"
+        return self._assemble(entries), None
 
     @staticmethod
     def _assemble(entries) -> Dict[str, np.ndarray]:

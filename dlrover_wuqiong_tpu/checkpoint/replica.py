@@ -24,7 +24,7 @@ import threading
 from typing import Dict, Optional, Tuple
 
 from ..common.log import get_logger
-from .shm_handler import SharedMemoryHandler
+from .shm_handler import SharedMemoryHandler, verify_segment_blob
 
 logger = get_logger("ckpt_replica")
 
@@ -220,6 +220,13 @@ class CkptReplicaManager:
         if seg is None:
             return 0
         step, blob = seg
+        # trust boundary: never replicate a segment that fails its own
+        # digests — shipping corruption would poison the peers' tier
+        vstep, why = verify_segment_blob(blob)
+        if vstep is None:
+            logger.error("refusing to replicate local segment of step %d:"
+                         " %s", step, why)
+            return 0
         sent = 0
         for peer in self._successors(count=len(self.peers)):
             if sent >= self.replica_count:
@@ -245,8 +252,14 @@ class CkptReplicaManager:
     def restore(self) -> Optional[int]:
         """Pull my segment from a backup holder into local shm.
 
-        Returns the restored step, or None when no peer holds a backup.
-        Parity: ShardCkptReplicaManager.gather (replica.py:191).
+        Every pulled blob is digest-verified (header crc + per-leaf
+        digests, shm_handler.verify_segment_blob) BEFORE it overwrites
+        the local segment — a peer holding corrupt bytes (bit flip in
+        its store, torn transfer) is skipped and the next holder tried,
+        so the replica tier can never clobber local state with garbage.
+
+        Returns the restored step, or None when no peer holds a valid
+        backup.  Parity: ShardCkptReplicaManager.gather (replica.py:191).
         """
         for peer, addr in sorted(self.peers.items()):
             if peer == self.rank:
@@ -258,11 +271,15 @@ class CkptReplicaManager:
                 continue
             if not header.get("found") or not payload:
                 continue
+            step, why = verify_segment_blob(payload)
+            if step is None:
+                logger.error("replica from rank %d fails verification "
+                             "(%s) — trying next holder", peer, why)
+                continue
             self._shm._ensure_size(len(payload))  # noqa: SLF001
             self._shm._buf.buf[:len(payload)] = payload  # noqa: SLF001
-            step = int(header["step"])
             logger.info("restored staged checkpoint step %d from rank %d "
-                        "(%.1f MB, no storage read)", step, peer,
+                        "(%.1f MB, verified, no storage read)", step, peer,
                         len(payload) / 1e6)
             return step
         return None

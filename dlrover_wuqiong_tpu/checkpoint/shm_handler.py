@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import json
 import math
+import os
 import threading
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
@@ -24,6 +25,7 @@ import numpy as np
 
 from ..common.log import get_logger
 from ..common.multi_process import SharedMemoryBuffer
+from .integrity import DIGEST_ALGO, digest_bytes
 
 logger = get_logger("shm_handler")
 
@@ -38,7 +40,12 @@ try:  # bfloat16/f8 numpy dtypes
 except ImportError:  # pragma: no cover
     _EXTRA_DTYPES = {}
 
-_HEADER_SIZE = 1 << 20  # fixed 1MB JSON header region
+_HEADER_SIZE = 1 << 20  # fixed 1MB header region
+# header layout: [0:8] big-endian json length (0 = empty/invalid, published
+# LAST for crash consistency), [8:12] crc of the json bytes (a bit flip in
+# the header itself must not yield a parseable-but-wrong meta), [12:12+n]
+# the json.  Payload starts at _HEADER_SIZE.
+_HDR_JSON_OFF = 12
 
 
 def _np_dtype(name: str) -> np.dtype:
@@ -59,12 +66,16 @@ class TensorMeta:
     global_shape: List[int] = field(default_factory=list)
     # per-dim [start, stop) of this shard within the global array
     index: List[List[int]] = field(default_factory=list)
+    # crc of this shard's staged bytes (-1 = legacy writer, fails the
+    # trust boundary's verification on purpose)
+    digest: int = -1
 
     def to_dict(self):
         return {
             "name": self.name, "dtype": self.dtype, "shape": self.shape,
             "offset": self.offset, "nbytes": self.nbytes,
             "global_shape": self.global_shape, "index": self.index,
+            "digest": self.digest,
         }
 
     @classmethod
@@ -195,14 +206,10 @@ class SharedMemoryHandler:
                 offset=offset, nbytes=nbytes, global_shape=gshape,
                 index=index))
             offset += nbytes
-        header = {
-            "step": step,
-            "metas": [m.to_dict() for m in metas],
-            "extra": extra_meta or {},
-        }
-        header_bytes = json.dumps(header).encode()
-        if len(header_bytes) + 8 > _HEADER_SIZE:
-            raise ValueError("checkpoint meta header exceeds 1MB")
+        extra = dict(extra_meta or {})
+        # creator pid: the saver-startup sweeper reaps segments whose
+        # creator died (same dead-pid pattern as SharedLock)
+        extra.setdefault("_pid", os.getpid())
         with self._lock:
             self._ensure_size(offset)
             buf = self._buf.buf
@@ -217,7 +224,21 @@ class SharedMemoryHandler:
                 host = np.ascontiguousarray(np.asarray(ref))
                 view = host.view(np.uint8).reshape(-1)
                 buf[meta.offset:meta.offset + meta.nbytes] = view
-            buf[8:8 + len(header_bytes)] = header_bytes
+                # digest the staged bytes: restore (any tier) refuses to
+                # hand a flipped/torn shard to device_put
+                meta.digest = digest_bytes(view.tobytes())
+            header = {
+                "step": step,
+                "algo": DIGEST_ALGO,
+                "metas": [m.to_dict() for m in metas],
+                "extra": extra,
+            }
+            header_bytes = json.dumps(header).encode()
+            if len(header_bytes) + _HDR_JSON_OFF > _HEADER_SIZE:
+                raise ValueError("checkpoint meta header exceeds 1MB")
+            buf[8:12] = digest_bytes(header_bytes).to_bytes(4, "big")
+            buf[_HDR_JSON_OFF:_HDR_JSON_OFF + len(header_bytes)] = \
+                header_bytes
             buf[0:8] = len(header_bytes).to_bytes(8, "big")
 
     # ------------------------------------------------------------------ read
@@ -225,14 +246,20 @@ class SharedMemoryHandler:
     def load_header(self) -> Optional[Dict]:
         if not self.attach():
             return None
+        return _parse_header(self._buf.buf)
+
+    def segment_state(self) -> str:
+        """"absent" | "empty" | "torn" | "ok" — distinguishes "nothing
+        staged" (benign cold start) from a header that is present but
+        fails its crc / parse (corruption the restore chain must report).
+        """
+        if not self.attach():
+            return "absent"
         buf = self._buf.buf
         n = int.from_bytes(bytes(buf[0:8]), "big")
-        if n == 0 or n > _HEADER_SIZE - 8:
-            return None
-        try:
-            return json.loads(bytes(buf[8:8 + n]).decode())
-        except ValueError:
-            return None
+        if n == 0:
+            return "empty"
+        return "ok" if _parse_header(buf) is not None else "torn"
 
     def load_state_dict(self) -> Optional[Tuple[int, Dict[str, np.ndarray],
                                                 List[TensorMeta], Dict]]:
@@ -260,6 +287,21 @@ class SharedMemoryHandler:
             meta = TensorMeta.from_dict(m)
             yield meta, buf[meta.offset:meta.offset + meta.nbytes]
 
+    def verify(self) -> Tuple[bool, str]:
+        """Digest-check every staged shard against its header meta.
+
+        (ok, reason) — reason "" on success, "no-segment" when nothing is
+        staged.  A legacy segment without digests FAILS (the trust
+        boundary does not grandfather undigested bytes)."""
+        from .integrity import verify_segment_entries
+
+        loaded = self.load_state_dict()
+        if loaded is None:
+            return False, "no-segment"
+        _, flat, metas, _ = loaded
+        header = self.load_header() or {}
+        return verify_segment_entries(metas, flat, header.get("algo", ""))
+
     def mark_empty(self):
         if self._buf is not None:
             self._buf.buf[0:8] = (0).to_bytes(8, "big")
@@ -280,3 +322,104 @@ class SharedMemoryHandler:
             self._buf.unlink()
             self._buf.close()
             self._buf = None
+
+
+# -------------------------------------------------- header / blob helpers
+
+
+def _parse_header(buf) -> Optional[Dict]:
+    """Header json out of a segment buffer/blob; None when empty or torn.
+
+    The 4-byte header crc catches a bit flip in the header region itself —
+    without it a flipped byte in a meta's offset/dtype would parse fine
+    and misread the payload."""
+    if len(buf) < _HDR_JSON_OFF:
+        return None
+    n = int.from_bytes(bytes(buf[0:8]), "big")
+    if n == 0 or n > _HEADER_SIZE - _HDR_JSON_OFF or \
+            _HDR_JSON_OFF + n > len(buf):
+        return None
+    raw = bytes(buf[_HDR_JSON_OFF:_HDR_JSON_OFF + n])
+    if digest_bytes(raw) != int.from_bytes(bytes(buf[8:12]), "big"):
+        return None
+    try:
+        return json.loads(raw.decode())
+    except ValueError:
+        return None
+
+
+def verify_segment_blob(blob: bytes) -> Tuple[Optional[int], str]:
+    """Verify a raw segment copy (replica wire blob) WITHOUT touching shm.
+
+    Returns (step, "") when every shard's digest matches its header meta,
+    else (None, reason) — the replica restore path checks the pulled blob
+    BEFORE overwriting the local segment, so a corrupt peer copy can
+    never clobber local state or reach device_put."""
+    header = _parse_header(blob)
+    if header is None:
+        return None, "torn-header"
+    from .integrity import DIGEST_ALGO as _ALGO
+
+    if header.get("algo", "") != _ALGO:
+        return None, "algo-mismatch"
+    for m in header.get("metas", []):
+        d = m.get("digest", -1)
+        if d is None or int(d) < 0:
+            return None, f"undigested-leaf:{m.get('name')}"
+        end = m["offset"] + m["nbytes"]
+        if end > len(blob):
+            return None, f"truncated-payload:{m.get('name')}"
+        if digest_bytes(blob[m["offset"]:end]) != int(d):
+            return None, f"leaf-digest-mismatch:{m.get('name')}"
+    return header.get("step", 0), ""
+
+
+# ------------------------------------------------- stale-segment sweeper
+
+
+def sweep_stale_segments(current_job: str) -> List[str]:
+    """Reap orphaned ckpt shm segments whose creator pid is dead.
+
+    POSIX shm outlives hard kills (CLAUDE.md): every SIGKILLed drill or
+    crashed run leaks its `{job}_ckpt_shm_{rank}` segments until reboot.
+    On saver startup we walk /dev/shm for the framework's naming pattern,
+    read each header's creator pid (stamped by save_state_dict), and
+    unlink segments whose creator no longer exists — the same dead-pid
+    reap SharedLock applies to lock holders (common/multi_process.py).
+
+    Segments of `current_job`, segments with live creators, and segments
+    whose header is unreadable (no pid evidence — may be mid-staging by a
+    live writer) are left alone.  Returns the reaped names.
+    """
+    from ..common.multi_process import _pid_alive
+
+    shm_root = "/dev/shm"
+    if not os.path.isdir(shm_root):  # non-Linux: nothing to sweep
+        return []
+    reaped: List[str] = []
+    for name in sorted(os.listdir(shm_root)):
+        if "_ckpt_shm_" not in name:
+            continue
+        if current_job and name.startswith(f"{current_job}_ckpt_shm_"):
+            continue
+        try:
+            seg = SharedMemoryBuffer(name)
+        except (FileNotFoundError, OSError):
+            continue
+        try:
+            header = _parse_header(seg.buf)
+            pid = (header or {}).get("extra", {}).get("_pid")
+            if pid is None or _pid_alive(int(pid)):
+                continue
+            seg.unlink()
+            reaped.append(name)
+            logger.warning("reaped stale ckpt shm segment %s "
+                           "(creator pid %s is dead)", name, pid)
+        except Exception:  # noqa: BLE001 — sweeping must never break startup
+            logger.exception("stale-segment sweep failed for %s", name)
+        finally:
+            try:
+                seg.close()
+            except Exception:  # noqa: BLE001
+                pass
+    return reaped

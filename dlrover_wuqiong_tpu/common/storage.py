@@ -124,12 +124,31 @@ class ObjectStoreStorage(CheckpointStorage):
         return self._epath.Path(path)
 
     def write(self, content, path: str):
+        """Atomic publish on every backend: object stores already commit
+        whole objects atomically, but epath on a POSIX path writes in
+        place — a crash mid-write would leave a torn file where the
+        checkpoint trust boundary expects manifests/trackers to be
+        whole-or-absent.  Write a sibling tmp then rename."""
         p = self._p(path)
         p.parent.mkdir(parents=True, exist_ok=True)
+        tmp = self._p(f"{path}.tmp.{os.getpid()}")
         if isinstance(content, str):
-            p.write_text(content)
+            tmp.write_text(content)
         else:
-            p.write_bytes(bytes(content))
+            tmp.write_bytes(bytes(content))
+        try:
+            tmp.rename(p)
+        except OSError:
+            # backends whose rename cannot replace: fall back to the
+            # object store's own atomic whole-object write
+            if isinstance(content, str):
+                p.write_text(content)
+            else:
+                p.write_bytes(bytes(content))
+            try:
+                tmp.unlink()
+            except OSError:
+                pass
 
     def write_fileobj(self, fileobj, path: str, length: int):
         p = self._p(path)

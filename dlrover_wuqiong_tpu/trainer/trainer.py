@@ -326,7 +326,20 @@ class Trainer:
                 self.state = restored
                 start_step = int(np.asarray(
                     jax.tree.leaves(self.state.step)[0]))
-                logger.info("resumed from step %d", start_step)
+                rep = self.ckpt.last_restore_report
+                logger.info("resumed from step %d (tier=%s%s)", start_step,
+                            rep.get("tier", "?"),
+                            ", degraded" if rep.get("fallbacks") else "")
+                if rep.get("fallbacks") and self.ctx.mc is not None:
+                    # checkpoint-health event: the master's event stream
+                    # is where operators see that a tier was corrupt and
+                    # which generation actually served the resume
+                    self.ctx.mc.report_node_event(
+                        "ckpt-health",
+                        f"degraded resume: tier={rep.get('tier')} "
+                        f"step={rep.get('step')} "
+                        f"fallbacks={rep.get('fallbacks')}",
+                        level="warning")
 
         last_loss = float("nan")
         metrics = None
@@ -350,6 +363,7 @@ class Trainer:
                     # two unfused steps measured (the first compiles):
                     # decide K, then fuse the rest of the run
                     fused_k = self._autotune_fused_k(step_time_s)
+                self._fused_k_active = fused_k or 0
                 if fused_k is not None and fused_k > 1 and stager is None:
                     from ..data.elastic_dataset import FusedBatchStager
 
@@ -455,8 +469,18 @@ class Trainer:
     def _save(self, step: int):
         from ..checkpoint.checkpointer import StorageType
 
+        # mesh/world shape + fused-K travel in the staging extras and land
+        # in the committed generation's manifest (checkpoint/integrity.py)
+        # — restore tooling can tell what world wrote a checkpoint
+        mesh = getattr(self.res, "mesh", None)
+        extra = {"mesh_shape": ({k: int(v) for k, v in
+                                 dict(mesh.shape).items()}
+                                if mesh is not None else {}),
+                 "fused_steps": int(getattr(self, "_fused_k_active", 0)
+                                    or self.args.fused_steps)}
         blocked = self.ckpt.save_checkpoint(
-            step, self.state, storage_type=StorageType.DISK)
+            step, self.state, storage_type=StorageType.DISK,
+            extra_meta=extra)
         self._last_saved_step = step
         logger.info("checkpoint step %d staged (blocked %.3fs)", step,
                     blocked)

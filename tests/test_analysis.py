@@ -600,6 +600,76 @@ class TestRawRpcCall:
         assert found == []
 
 
+class TestUnverifiedRestore:
+    def test_shm_bytes_to_device_put_flagged(self, tmp_path):
+        found = _scan_source(
+            tmp_path, "pkg/ckpt/restorer.py", """\
+            '''Parity: ref.py:1'''
+            import jax
+
+            def resume(handler, sharding):
+                step, flat, metas, extra = handler.load_state_dict()
+                return jax.device_put(flat["w"], sharding)
+            """)
+        assert [f.checker for f in found] == ["unverified-restore"]
+        assert "device_put" in found[0].message
+        assert found[0].line == 6
+
+    def test_frombuffer_to_restore_pytree_flagged(self, tmp_path):
+        found = _scan_source(
+            tmp_path, "pkg/ckpt/loader.py", """\
+            '''Parity: ref.py:1'''
+            import numpy as np
+
+            def load(storage, template, path):
+                raw = storage.read(path)
+                flat = {"w": np.frombuffer(raw, dtype=np.float32)}
+                return restore_pytree(template, flat)
+            """)
+        assert [f.checker for f in found] == ["unverified-restore"]
+        assert "restore_pytree" in found[0].message
+
+    def test_verified_decode_clean(self, tmp_path):
+        found = _scan_source(
+            tmp_path, "pkg/ckpt/loader.py", """\
+            '''Parity: ref.py:1'''
+            import numpy as np
+
+            def load(storage, template, path, entry):
+                raw = storage.read(path)
+                verify_rank_bytes(raw, entry, "crc32c", 0)
+                flat = {"w": np.frombuffer(raw, dtype=np.float32)}
+                return restore_pytree(template, flat)
+            """)
+        assert found == []
+
+    def test_sink_without_raw_source_clean(self, tmp_path):
+        # restore_pytree fed by the verified engine API in ANOTHER
+        # function: the sanctioned shape (engine.load verifies inside)
+        found = _scan_source(
+            tmp_path, "pkg/ckpt/user.py", """\
+            '''Parity: ref.py:1'''
+            import jax
+
+            def resume(engine, template, sharding):
+                flat = engine.load()
+                return jax.device_put(flat["w"], sharding)
+            """)
+        assert found == []
+
+    def test_tests_and_suppression_exempt(self, tmp_path):
+        src = """\
+            '''Parity: ref.py:1'''
+            import jax
+
+            def resume(handler, sharding):
+                step, flat, metas, extra = handler.load_state_dict()
+                return jax.device_put(flat["w"], sharding)  # graftlint: disable=unverified-restore
+            """
+        assert _scan_source(tmp_path, "pkg/tests/test_x.py", src) == []
+        assert _scan_source(tmp_path, "pkg/ckpt/sanctioned.py", src) == []
+
+
 class TestControlPlaneHygiene:
     def test_pickle_on_frame_path_flagged(self, tmp_path):
         found = _scan_source(

@@ -29,6 +29,22 @@ def test_network_partition_relaunches_silent_node():
     assert report["dead_detected"] == [1]
 
 
+def test_ckpt_corrupt_zero_silent_restores():
+    """Checkpoint trust boundary (ISSUE 5): the full corruption fault
+    matrix — flipped bytes in shm/replica/storage, truncated shard,
+    missing manifest, stale-generation-only, SIGKILL mid-persist — with
+    zero silent restores, best-healthy-tier selection, bit-identical
+    resume, and self-heal after every degraded restore."""
+    report = chaos.ckpt_corrupt()
+    assert report["ok"], report
+    assert report["silent_restores"] == 0
+    assert len(report["cases"]) == 7
+    # every corrupt-fault case both detected the fault AND healed
+    for case in report["cases"]:
+        assert case["bit_identical"], case
+    assert report["doctor"]["flagged_steps"] == [4]
+
+
 def test_cli_runs_all(capsys):
     rc = chaos.main(["straggler", "network-partition"])
     assert rc == 0

@@ -413,6 +413,324 @@ class TestRollbackBeforeStep:
         ck.close()
 
 
+class TestTrustBoundary:
+    """Checkpoint trust boundary (checkpoint/integrity.py): digests at
+    every tier, atomic manifest commit, quarantine-not-delete, verified
+    fallback, self-heal."""
+
+    def _commit(self, ck, step, value, shape=(8, 8)):
+        ck.save_checkpoint(step, {"w": jnp.full(shape, value),
+                                  "step": np.int64(step)},
+                           storage_type=StorageType.DISK)
+        assert ck.wait_latest_checkpoint(30)
+
+    def test_manifest_roundtrip_across_dtypes_and_shardings(self, tmp_path):
+        """Property test: a committed generation's manifest verifies
+        per-leaf for every dtype/sharding combination the stack stages,
+        and restore is exact for each."""
+        from dlrover_wuqiong_tpu.checkpoint.integrity import (
+            read_manifest,
+            verify_storage_step,
+        )
+        from dlrover_wuqiong_tpu.common.storage import PosixDiskStorage
+
+        mesh = _mesh()
+        ckpt_dir = str(tmp_path / "prop")
+        ck = FlashCheckpointer(ckpt_dir, job_name="t-tb-prop",
+                               standalone=True)
+        rng = np.random.default_rng(0)
+        state = {
+            "f32_2d": jax.device_put(
+                jnp.asarray(rng.normal(size=(8, 8)), jnp.float32),
+                NamedSharding(mesh, P("data", "model"))),
+            "f32_rep": jax.device_put(jnp.asarray(
+                rng.normal(size=(4, 4)), jnp.float32),
+                NamedSharding(mesh, P())),
+            "bf16_row": jax.device_put(jnp.asarray(
+                rng.normal(size=(8, 2)), jnp.bfloat16),
+                NamedSharding(mesh, P("data", None))),
+            "i32": jnp.arange(16, dtype=jnp.int32),
+            "u8": jnp.asarray(rng.integers(0, 255, (5,)), jnp.uint8),
+            "scalar": np.int64(42),
+        }
+        ck.save_checkpoint(3, state, storage_type=StorageType.DISK)
+        assert ck.wait_latest_checkpoint(30)
+        storage = PosixDiskStorage()
+        # deep (per-leaf) verification passes on healthy bytes
+        v = verify_storage_step(storage, ckpt_dir, 3, per_leaf=True)
+        assert v["ok"] and not v["bad_leaves"], v
+        m = read_manifest(storage, str(tmp_path / "prop" / "checkpoint-3"))
+        assert m["step"] == 3 and m["algo"] and m["ranks"], m
+        # exact round trip for every leaf
+        template = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(
+                x.shape, x.dtype, sharding=getattr(x, "sharding", None))
+            if hasattr(x, "sharding") else x, state)
+        ck.engine._shm_handler.mark_empty()  # force the storage tier
+        restored = ck.load_checkpoint(template)
+        assert ck.last_restore_report["tier"] == "storage"
+        for name, a in flatten_state_dict(state).items():
+            b = flatten_state_dict(restored)[name]
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        ck.close()
+
+    def test_torn_manifest_falls_back_and_quarantines(self, tmp_path):
+        ckpt_dir = str(tmp_path / "torn")
+        ck = FlashCheckpointer(ckpt_dir, job_name="t-tb-torn",
+                               standalone=True)
+        for step, val in ((5, 5.0), (10, 10.0)):
+            self._commit(ck, step, val)
+        # tear the newest manifest mid-json (as a crashed rewrite would)
+        mpath = os.path.join(ckpt_dir, "checkpoint-10", "manifest.json")
+        raw = open(mpath).read()
+        open(mpath, "w").write(raw[:len(raw) // 2])
+        ck.engine._shm_handler.mark_empty()
+        restored = ck.load_checkpoint({"w": jnp.zeros((8, 8)),
+                                       "step": np.int64(0)})
+        rep = ck.last_restore_report
+        np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                      np.full((8, 8), 5.0))
+        assert rep["tier"] == "storage" and rep["step"] == 5
+        assert any(f["reason"] == "missing-manifest"
+                   for f in rep["fallbacks"])  # torn == unreadable
+        qdir = tmp_path / "torn" / ".quarantine" / "checkpoint-10"
+        assert qdir.is_dir()  # evidence moved aside, not deleted
+        assert (qdir / ".reason").exists()
+        ck.close()
+
+    def test_shm_flip_detected_heals_and_reverifies(self, tmp_path):
+        ckpt_dir = str(tmp_path / "flip")
+        ck = FlashCheckpointer(ckpt_dir, job_name="t-tb-flip",
+                               standalone=True)
+        self._commit(ck, 7, 7.0)
+        h = ck.engine._shm_handler
+        ok, _ = h.verify()
+        assert ok
+        buf = h._buf.buf
+        buf[1 << 20] = (buf[1 << 20] + 1) % 256  # first payload byte
+        ok, why = h.verify()
+        assert not ok and "digest-mismatch" in why
+        restored = ck.load_checkpoint({"w": jnp.zeros((8, 8)),
+                                       "step": np.int64(0)})
+        rep = ck.last_restore_report
+        np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                      np.full((8, 8), 7.0))
+        assert rep["tier"] == "storage" and rep["healed"]
+        assert any(f["tier"] == "shm" for f in rep["fallbacks"])
+        # self-heal re-staged a verified copy: next load is the fast tier
+        restored = ck.load_checkpoint({"w": jnp.zeros((8, 8)),
+                                       "step": np.int64(0)})
+        assert ck.last_restore_report["tier"] == "shm"
+        ck.close()
+
+    def test_corrupt_shm_never_persists(self, tmp_path):
+        """The saver digest-checks while streaming shm → storage: a
+        segment corrupted AFTER staging must abort the persist, never
+        become a committed generation."""
+        ckpt_dir = str(tmp_path / "nop")
+        ck = FlashCheckpointer(ckpt_dir, job_name="t-tb-nop",
+                               standalone=True)
+        self._commit(ck, 1, 1.0)
+        ck.save_checkpoint(2, {"w": jnp.full((8, 8), 2.0),
+                               "step": np.int64(2)},
+                           storage_type=StorageType.MEMORY)
+        ck.wait_staging(30)
+        h = ck.engine._shm_handler
+        h._buf.buf[1 << 20] ^= 0xFF  # corrupt the staged step-2 payload
+        saver = AsyncCheckpointSaver.get_ckpt_saver()
+        saver.save_step_checkpoint(2, ckpt_dir, commit_timeout=3)
+        assert read_last_step(ckpt_dir) == 1  # step 2 never committed
+        marker = os.path.join(ckpt_dir, "checkpoint-2", ".commit")
+        assert not os.path.exists(marker)
+        ck.close()
+
+    def test_replica_blob_verification(self):
+        from dlrover_wuqiong_tpu.checkpoint.shm_handler import (
+            verify_segment_blob,
+        )
+
+        h = SharedMemoryHandler(0, "t-tb-blob")
+        try:
+            h.save_state_dict(
+                {"w": np.arange(32, dtype=np.float32)}, step=4)
+            end = 1 << 20
+            for m in h.load_header()["metas"]:
+                end = max(end, m["offset"] + m["nbytes"])
+            blob = bytes(h._buf.buf[:end])
+            step, why = verify_segment_blob(blob)
+            assert step == 4 and why == ""
+            bad = bytearray(blob)
+            bad[1 << 20] ^= 0x01
+            step, why = verify_segment_blob(bytes(bad))
+            assert step is None and "digest-mismatch" in why
+            # torn header (truncated mid-json) is rejected too
+            step, why = verify_segment_blob(blob[:100])
+            assert step is None and why == "torn-header"
+        finally:
+            h.unlink()
+
+
+_MID_PERSIST_SAVER = r"""
+import os, sys
+import numpy as np
+
+from dlrover_wuqiong_tpu.checkpoint.checkpointer import (
+    FlashCheckpointer, StorageType)
+
+ckpt_dir = sys.argv[1]
+ck = FlashCheckpointer(ckpt_dir, job_name=os.environ["DWT_JOB_NAME"],
+                       standalone=True)
+ck.save_checkpoint(1, {"w": np.full((8, 8), 1.0, np.float32),
+                       "step": np.int64(1)},
+                   storage_type=StorageType.DISK)
+assert ck.wait_latest_checkpoint(60)
+os.environ["DWT_CKPT_CRASH_POINT"] = sys.argv[2]
+ck.save_checkpoint(2, {"w": np.full((8, 8), 2.0, np.float32),
+                       "step": np.int64(2)},
+                   storage_type=StorageType.DISK)
+ck.wait_latest_checkpoint(60)
+"""
+
+
+class TestSigkillMidPersist:
+    """The saver dies BETWEEN the shard-file write and the manifest
+    publish (and, separately, between done-files and manifest): the torn
+    generation is invisible-or-quarantined, restore serves N-1, and the
+    dead run's shm segment is reaped by the next saver's sweeper."""
+
+    @pytest.mark.parametrize("crash_point", ["after-bin",
+                                             "before-manifest"])
+    def test_restore_falls_back_to_previous_generation(
+            self, tmp_path, crash_point):
+        import subprocess
+        import sys as _sys
+        import tempfile
+
+        ckpt_dir = str(tmp_path / "mp")
+        job = f"mp{os.getpid()}{'a' if crash_point == 'after-bin' else 'b'}"
+        script = tmp_path / "saver.py"
+        script.write_text(_MID_PERSIST_SAVER)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ, DWT_JOB_NAME=job,
+                   # a short dir: AF_UNIX socket paths cap at ~108 chars
+                   # and pytest tmp_path nests deep
+                   DWT_SOCKET_DIR=tempfile.mkdtemp(prefix="dwt-mp-"),
+                   JAX_PLATFORMS="cpu",
+                   PYTHONPATH=repo + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""))
+        proc = subprocess.run(
+            [_sys.executable, str(script), ckpt_dir, crash_point],
+            env=env, cwd=str(tmp_path), capture_output=True, text=True,
+            timeout=120)
+        assert proc.returncode == 137, proc.stdout + proc.stderr
+        # generation 2 must be torn by construction: no manifest
+        assert not os.path.exists(os.path.join(
+            ckpt_dir, "checkpoint-2", "manifest.json"))
+
+        AsyncCheckpointSaver.reset()
+        ck = FlashCheckpointer(ckpt_dir, job_name=f"{job}-verify",
+                               standalone=True)
+        try:
+            # sweeper reaped the dead saver's segment on startup
+            assert not os.path.exists(f"/dev/shm/{job}_ckpt_shm_0")
+            restored = ck.load_checkpoint({"w": jnp.zeros((8, 8)),
+                                           "step": np.int64(0)})
+            rep = ck.last_restore_report
+            assert restored is not None and int(restored["step"]) == 1
+            np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                          np.full((8, 8), 1.0))
+            assert rep["step"] == 1 and rep["tier"] == "storage"
+        finally:
+            ck.close()
+
+
+class TestCkptDoctor:
+    def test_doctor_verifies_flags_and_repairs(self, tmp_path):
+        import json as _json
+        import subprocess
+        import sys as _sys
+
+        ckpt_dir = str(tmp_path / "doc")
+        ck = FlashCheckpointer(ckpt_dir, job_name="t-doc1",
+                               standalone=True)
+        for step, val in ((2, 2.0), (4, 4.0)):
+            ck.save_checkpoint(step, {"w": jnp.full((8, 8), val),
+                                      "step": np.int64(step)},
+                               storage_type=StorageType.DISK)
+            assert ck.wait_latest_checkpoint(30)
+        ck.close()
+        AsyncCheckpointSaver.reset()
+        doctor = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "ckpt_doctor.py")
+
+        def run(*args):
+            p = subprocess.run([_sys.executable, doctor, ckpt_dir, *args],
+                               capture_output=True, text=True, timeout=60)
+            return p.returncode, _json.loads(
+                p.stdout.strip().splitlines()[-1])["ckpt_doctor"]
+
+        rc, v = run("--deep")
+        assert rc == 0 and v["ok"] and v["healthy_steps"] == [4, 2]
+        # flip one byte in the newest shard file
+        import glob
+
+        bin4 = glob.glob(os.path.join(ckpt_dir, "checkpoint-4",
+                                      "shards_rank*.bin"))[0]
+        raw = bytearray(open(bin4, "rb").read())
+        raw[10] ^= 0x02
+        open(bin4, "wb").write(raw)
+        rc, v = run()
+        assert rc == 1 and not v["ok"]
+        bad = [g for g in v["generations"] if not g["ok"]]
+        assert [g["step"] for g in bad] == [4]
+        # repair: quarantine + tracker repointed to the healthy gen
+        rc, v = run("--repair")
+        assert v["quarantined_now"] == [4]
+        assert v["tracker_step"] == 2
+        assert read_last_step(ckpt_dir) == 2
+        rc, v = run()
+        assert rc == 0 and v["ok"] and v["healthy_steps"] == [2]
+
+
+class TestStaleSegmentSweeper:
+    def test_dead_creator_reaped_live_spared(self, tmp_path):
+        import subprocess
+        import sys as _sys
+
+        from dlrover_wuqiong_tpu.checkpoint.shm_handler import (
+            sweep_stale_segments,
+        )
+
+        dead_job = f"t-sweep-dead-{os.getpid()}"
+        live_job = f"t-sweep-live-{os.getpid()}"
+        # a subprocess stages a segment and exits (its pid dies with it)
+        code = (
+            "import numpy as np, sys;"
+            "from dlrover_wuqiong_tpu.checkpoint.shm_handler import "
+            "SharedMemoryHandler;"
+            f"h = SharedMemoryHandler(0, {dead_job!r});"
+            "h.save_state_dict({'w': np.ones(4, np.float32)}, step=1);"
+            "h.close()")
+        subprocess.run([_sys.executable, "-c", code], check=True,
+                       timeout=60, env=dict(os.environ,
+                                            JAX_PLATFORMS="cpu"))
+        assert os.path.exists(f"/dev/shm/{dead_job}_ckpt_shm_0")
+        # this process stages one too (creator alive)
+        h = SharedMemoryHandler(0, live_job)
+        h.save_state_dict({"w": np.ones(4, np.float32)}, step=1)
+        try:
+            reaped = sweep_stale_segments("some-other-job")
+            assert f"{dead_job}_ckpt_shm_0" in reaped
+            assert not os.path.exists(f"/dev/shm/{dead_job}_ckpt_shm_0")
+            # live creator: spared
+            assert os.path.exists(f"/dev/shm/{live_job}_ckpt_shm_0")
+            # segments of the current job are never touched either
+            assert f"{live_job}_ckpt_shm_0" not in sweep_stale_segments(
+                live_job)
+        finally:
+            h.unlink()
+
+
 class TestWireDtype:
     """bf16 wire staging (r4 verdict next #3): halves bytes end to end.
     Exact-resume contract: f32 leaves come back bf16-quantized (documented

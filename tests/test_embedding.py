@@ -237,7 +237,15 @@ class TestSparseOptim:
 
     def test_adabelief_matches_optax(self):
         import optax
+        from version_gates import optax_belief_uses_stale_mu
 
+        if optax_belief_uses_stale_mu():
+            pytest.xfail(
+                "this optax's scale_by_belief computes the prediction "
+                "error against the PRE-update EMA (optax 0.2.x); the "
+                "sparse kernel follows the AdaBelief paper (post-update "
+                "EMA) — exact tracking is impossible here (probed "
+                "numerically, tests/version_gates.py)")
         self._vs_optax("adabelief",
                        optax.adabelief(0.1, eps=1e-8, eps_root=1e-8),
                        {"eps": 1e-8})
