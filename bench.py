@@ -42,23 +42,42 @@ import jax.numpy as jnp
 BASELINE_TOKENS_PER_SEC = 150_000.0  # nanoGPT GPT-2 124M on A100, bf16
 
 
-def _init_backend_with_retry(attempts: int = 3, base_delay_s: float = 5.0,
-                             probe=None):
-    """First backend touch with bounded backoff (5s, 10s, then fail).
+#: default wall-clock window the first backend touch may ride out an
+#: axon outage (DWT_BENCH_INIT_DEADLINE_S overrides; 0 disables retry).
+_INIT_DEADLINE_S = 300.0
+
+
+def _init_backend_with_retry(deadline_s: float = None,
+                             base_delay_s: float = 5.0, probe=None):
+    """First backend touch, retried across the FULL init window.
 
     A transient axon-tunnel outage at startup previously produced an
-    rc-1 artifact with no benchmark line (BENCH_r05.json); three tries
-    with the backend torn down in between ride out a blip without
-    masking a real outage.  All retry chatter goes to stderr — stdout
-    stays the single JSON line.  EVERY backend touch goes through here
-    (`probe` defaults to jax.devices; main's backend-name query passes
+    rc-1 artifact with no benchmark line (BENCH_r05.json rc=1); the old
+    3-attempt ladder (5s, 10s — a ~15s window) still voided the round
+    when the tunnel took a minute to come back.  Now the retry is
+    DEADLINE-bounded: exponential backoff (5s → 60s cap) for as long as
+    the init window allows (default 300s, DWT_BENCH_INIT_DEADLINE_S
+    overrides), so an outage shorter than the window degrades to a
+    delayed datapoint instead of a voided round, and a real outage
+    still fails — loudly, after the window — with the JSON contract
+    intact.  All retry chatter goes to stderr — stdout stays the single
+    JSON line.  EVERY backend touch goes through here (`probe` defaults
+    to jax.devices; main's backend-name query passes
     jax.default_backend) so no call path can die with a raw traceback
     before the JSON contract is emitted.  The loop itself is the repo's
     shared `retry_call` (common/util.py) — one retry policy everywhere;
     this wrapper only supplies the backend-specific teardown."""
     from dlrover_wuqiong_tpu.common.util import retry_call
 
+    if deadline_s is None:
+        try:
+            deadline_s = float(os.getenv("DWT_BENCH_INIT_DEADLINE_S",
+                                         _INIT_DEADLINE_S))
+        except ValueError:
+            deadline_s = _INIT_DEADLINE_S
     probe = probe if probe is not None else jax.devices
+    if deadline_s <= 0:
+        return probe()
     used = {"retries": 0}
 
     def on_retry(n, exc, delay):
@@ -75,9 +94,12 @@ def _init_backend_with_retry(attempts: int = 3, base_delay_s: float = 5.0,
             pass
 
     # retry_on=Exception: backend init has no stable exception type across
-    # plugins (RuntimeError, XlaRuntimeError, grpc errors over the tunnel)
-    out = retry_call(probe, attempts=attempts, base_delay_s=base_delay_s,
-                     max_delay_s=60.0, jitter=0.0, on_retry=on_retry)
+    # plugins (RuntimeError, XlaRuntimeError, grpc errors over the tunnel).
+    # attempts=None: bounded by the deadline alone — the count that fits
+    # the window is the window's business, not a magic constant's
+    out = retry_call(probe, attempts=None, deadline_s=deadline_s,
+                     base_delay_s=base_delay_s, max_delay_s=60.0,
+                     jitter=0.0, on_retry=on_retry)
     if used["retries"]:
         print(json.dumps({"backend_init_recovered_attempt":
                           used["retries"] + 1}), file=sys.stderr)
@@ -399,7 +421,7 @@ def _fused_vs_perstep(res, cfg, batch, seq, state):
         st, m = res.train_step(st, b)
         # the per-step sync under measurement: this driver's cost IS the
         # rule the linter enforces, so the suppression is the point
-        float(m["loss"])  # graftlint: disable=blocking-readback
+        float(m["loss"])  # graftlint: disable=blocking-readback -- unfused baseline: the per-step sync IS what this driver measures
     per_step_s = (time.perf_counter() - t0) / steps
 
     # chained reference (batch pre-placed, one readback for the whole

@@ -82,16 +82,12 @@ DONATING_CALLS: Dict[str, Tuple[Tuple[int, ...], Tuple[str, ...]]] = {
 
 _CITE_RE = re.compile(r"[\w/\.-]+\.(?:py|cc|h|proto|md):\d+|\bparity\b",
                       re.IGNORECASE)
-_DISABLE_RE = re.compile(r"graftlint:\s*disable=([\w,-]+)")
 _ENV_PREFIX = "DWT_"
 
-
-def _suppressed(source_lines: Sequence[str], line: int, checker: str) -> bool:
-    if 0 < line <= len(source_lines):
-        m = _DISABLE_RE.search(source_lines[line - 1])
-        if m and checker in m.group(1).split(","):
-            return True
-    return False
+# v2 suppression grammar lives in findings.py (shared with the protocol
+# engine); reason-less disables are themselves findings — see
+# check_suppression_reasons, run once per file below.
+from .findings import is_suppressed as _suppressed  # noqa: E402
 
 
 def _dotted(node: ast.AST) -> Optional[str]:
@@ -765,4 +761,8 @@ def run_paths(paths: Sequence[str],
                 check_control_plane_hygiene(rel, tree, lines))
         if not checkers or "docstring-citation" in checkers:
             findings.extend(check_docstring_citation(rel, tree, lines))
+        if not checkers or "suppression-no-reason" in checkers:
+            from .findings import check_suppression_reasons
+
+            findings.extend(check_suppression_reasons(rel, lines))
     return findings, len(files)

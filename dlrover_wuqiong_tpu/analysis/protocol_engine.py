@@ -1,0 +1,637 @@
+"""graftlint protocol engine: interprocedural control-plane invariants.
+
+Parity: no single reference counterpart — reference dlrover encodes its
+control-plane protocol (journal-then-ack in `master/servicer.py`,
+atomic checkpoint publishes in `common/storage.py`) purely as runtime
+behavior; regressions surface as flaky chaos drills.  Here the PR 4/5
+invariants that so far existed only as CLAUDE.md prose become statically
+checked rules that span FUNCTIONS, not lines: the engine builds a
+per-module call graph over the AST (methods resolved within their class,
+bare names within their module), computes each function's transitive
+*effects* (journal-append, manifest-publish, commit-evidence, ...), and
+then checks ordering/dataflow invariants against those effects.
+
+Like the AST engine this imports no jax — it runs in the
+`__graft_entry__.py` pre-flight before any backend exists.
+
+Rules (catalog + severities in findings.RULE_CATALOG):
+
+- ``journal-before-ack``: in a servicer class (one that defines a
+  ``_journal`` helper), every isinstance-branch handling a verb in
+  JOURNALED_VERBS must reach a journal append, and that append must
+  precede the branch's final (success) return in statement order —
+  acked mutations must be durable ones.  Early returns are the
+  no-mutation paths by construction (task-exhausted, not-created) and
+  are tolerated; the regression this catches is a new mutating verb
+  acked without any append, or an append moved below the ack.
+- ``idem-key-required``: verbs in IDEM_VERBS are retried across master
+  restarts and must thread an idempotency key end to end — the servicer
+  branch's journal call must carry ``idem=``, and the MasterClient
+  method building that payload must pass ``idem=`` into its transport
+  call.
+- ``commit-order``: a write naming ``COMMIT_MARKER`` must be preceded
+  (in the same function, transitively through local calls) by a
+  manifest publish; a write naming ``TRACKER_FILE`` by a manifest
+  publish OR commit evidence (a manifest/marker read-and-verify) — the
+  tracker may legally repoint to an already-committed generation, but
+  never publish a generation no one verified.
+- ``atomic-publish``: raw ``open(path, "w"/"wb")`` on a published
+  control file (manifest/tracker/marker/spec/inflight/...) tears under
+  crash; route through storage.write (write-tmp + fsync + rename) or a
+  local tmp with an os.replace.  The helper itself
+  (ATOMIC_HELPER_FILES) is sanctioned.
+- ``lock-leak``: an ``<x>.acquire(...)`` on a lock-named object whose
+  matching ``<x>.release()`` is not inside a ``finally`` block of the
+  same function leaks the cross-process SharedLock when this process
+  dies mid-section (the lock outlives hard kills — CLAUDE.md).  The
+  lock service implementation itself (LOCK_IMPL_FILES) is sanctioned,
+  as are ``with``-statement acquisitions.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .findings import Finding, is_suppressed
+
+# --------------------------------------------------------------- protocol
+# The protocol tables ARE the spec: a new mutating verb must be added
+# here (and to the servicer) in the same PR, exactly like v1's
+# DONATING_CALLS / FRAME_IO_CALLS tables.
+
+#: message payload types whose servicer branch mutates durable master
+#: state and therefore must journal before acking (master/servicer.py).
+JOURNALED_VERBS = {
+    "TaskRequest", "KVStoreAddRequest", "JoinRendezvousRequest",
+    "TaskResult", "DatasetShardParams", "NodeMeta", "NodeFailure",
+    "KVStoreSetRequest", "ShardCheckpoint",
+}
+
+#: verbs that are NOT naturally idempotent across a master restart: the
+#: idem key + journaled response make their retries at-most-once.
+IDEM_VERBS = {
+    "TaskRequest", "KVStoreAddRequest", "JoinRendezvousRequest",
+    "TaskResult",
+}
+
+#: names whose (transitive) call means "a manifest was published".
+MANIFEST_PUBLISHERS = {"write_manifest", "_write_step_manifest"}
+
+#: names whose (transitive) call means "commit state was read/verified"
+#: — a tracker repoint after these targets an already-committed step.
+COMMIT_EVIDENCE = {"read_manifest", "read_last_step"}
+
+#: constants naming the two published commit files (common/constants.py).
+MARKER_CONSTS = {"COMMIT_MARKER"}
+TRACKER_CONSTS = {"TRACKER_FILE"}
+
+#: path-text fragments that mark a file as a *published* control file
+#: for atomic-publish (read by another process / a later generation).
+PUBLISHED_HINTS = (
+    "manifest", "tracker", ".commit", ".done", ".spec", ".inflight",
+    "snapshot", "latest_checkpointed",
+)
+
+#: the blessed write-tmp+fsync+rename implementations themselves.
+ATOMIC_HELPER_FILES = ("common/storage.py",)
+
+#: the SharedLock/socket service implementation (its internal
+#: threading.Lock bookkeeping is the mechanism, not a client).
+LOCK_IMPL_FILES = ("common/multi_process.py",)
+
+#: transport senders a client verb may thread its idem key into.
+CLIENT_TRANSPORT_CALLS = {"_call", "_call_critical"}
+
+
+# ------------------------------------------------------------- call graph
+
+
+class FuncInfo:
+    """One function/method: AST node + resolution context."""
+
+    __slots__ = ("qualname", "node", "cls", "calls", "effects")
+
+    def __init__(self, qualname: str, node: ast.AST, cls: Optional[str]):
+        self.qualname = qualname
+        self.node = node
+        self.cls = cls
+        self.calls: Set[str] = set()     # resolved local qualnames
+        self.effects: Set[str] = set()   # direct effects, pre-closure
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _terminal(node: ast.AST) -> Optional[str]:
+    """Last attribute/name of a callee: `self.storage.write` -> 'write'."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    """All Name ids and Attribute attrs under `node` (constant spotting)."""
+    out: Set[str] = set()
+    for child in ast.walk(node):
+        if isinstance(child, ast.Name):
+            out.add(child.id)
+        elif isinstance(child, ast.Attribute):
+            out.add(child.attr)
+    return out
+
+
+class ModuleGraph:
+    """Per-module call graph with transitive effect closure.
+
+    Calls are resolved conservatively: ``self.foo(...)``/``cls.foo(...)``
+    to a method of the enclosing class, bare ``foo(...)`` to a module
+    function (imported names resolve by terminal name when a module
+    function of that name exists — good enough for the in-repo
+    ``from .integrity import write_manifest`` idiom).
+    """
+
+    def __init__(self, tree: ast.Module):
+        self.funcs: Dict[str, FuncInfo] = {}
+        self.by_class: Dict[str, Set[str]] = {}
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._add(node, None)
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        self._add(sub, node.name)
+        for info in self.funcs.values():
+            self._collect_calls(info)
+
+    def _add(self, node, cls: Optional[str]):
+        qual = f"{cls}.{node.name}" if cls else node.name
+        self.funcs[qual] = FuncInfo(qual, node, cls)
+        if cls:
+            self.by_class.setdefault(cls, set()).add(node.name)
+
+    def resolve(self, call: ast.Call, cls: Optional[str]) -> Optional[str]:
+        """Local qualname a call resolves to, or None (external)."""
+        fn = call.func
+        if isinstance(fn, ast.Attribute) and \
+                isinstance(fn.value, ast.Name) and \
+                fn.value.id in ("self", "cls") and cls and \
+                fn.attr in self.by_class.get(cls, ()):
+            return f"{cls}.{fn.attr}"
+        if isinstance(fn, ast.Name) and fn.id in self.funcs:
+            return fn.id
+        return None
+
+    def _collect_calls(self, info: FuncInfo):
+        for child in ast.walk(info.node):
+            if isinstance(child, ast.Call):
+                target = self.resolve(child, info.cls)
+                if target:
+                    info.calls.add(target)
+
+    def transitive_effects(self, qual: str,
+                           _seen: Optional[Set[str]] = None) -> Set[str]:
+        if _seen is None:
+            _seen = set()
+        if qual in _seen or qual not in self.funcs:
+            return set()
+        _seen.add(qual)
+        info = self.funcs[qual]
+        out = set(info.effects)
+        for callee in info.calls:
+            out |= self.transitive_effects(callee, _seen)
+        return out
+
+
+def _mark_effects(graph: ModuleGraph):
+    """Stamp direct effects onto every function, pre-closure."""
+    for info in graph.funcs.values():
+        name = info.qualname.rsplit(".", 1)[-1]
+        if name in MANIFEST_PUBLISHERS:
+            info.effects.add("manifest-publish")
+        if name in COMMIT_EVIDENCE:
+            info.effects.add("commit-evidence")
+        # a function that references a commit/manifest constant anywhere
+        # AND reads storage is consulting commit state (the constant may
+        # live in a path assignment, not the read call itself —
+        # engine.committed_steps builds `marker` then storage.exists(it))
+        fn_names = _names_in(info.node)
+        if fn_names & (MARKER_CONSTS | {"MANIFEST_NAME"}):
+            for child in ast.walk(info.node):
+                if isinstance(child, ast.Call) and \
+                        _terminal(child.func) in ("exists", "read",
+                                                  "listdir"):
+                    info.effects.add("commit-evidence")
+                    break
+        for child in ast.walk(info.node):
+            if not isinstance(child, ast.Call):
+                continue
+            term = _terminal(child.func)
+            if term == "append" and _dotted(child.func) and \
+                    "journal" in _dotted(child.func):
+                info.effects.add("journal-append")
+            if term in MANIFEST_PUBLISHERS:
+                info.effects.add("manifest-publish")
+            if term in COMMIT_EVIDENCE:
+                info.effects.add("commit-evidence")
+            names = _names_in(child)
+            if term in ("write", "open", "write_fileobj", "replace") \
+                    and names & MARKER_CONSTS:
+                info.effects.add("marker-write")
+            if term in ("exists", "read") and \
+                    names & (MARKER_CONSTS | {"MANIFEST_NAME"}):
+                info.effects.add("commit-evidence")
+
+
+# ------------------------------------------------------- rule: servicer
+
+
+def _isinstance_verb(test: ast.AST) -> Set[str]:
+    """Message type names from `isinstance(payload, msg.X)` tests."""
+    out: Set[str] = set()
+    if isinstance(test, ast.Call) and \
+            isinstance(test.func, ast.Name) and \
+            test.func.id == "isinstance" and len(test.args) == 2:
+        types = test.args[1]
+        cands = types.elts if isinstance(types, ast.Tuple) else [types]
+        for t in cands:
+            term = _terminal(t)
+            if term:
+                out.add(term)
+    return out
+
+
+def _branch_journal_calls(branch: List[ast.stmt], graph: ModuleGraph,
+                          cls: Optional[str]) -> List[ast.Call]:
+    """Calls inside `branch` that transitively reach a journal append."""
+    out = []
+    for stmt in branch:
+        for child in ast.walk(stmt):
+            if isinstance(child, ast.Call):
+                target = graph.resolve(child, cls)
+                if target and "journal-append" in \
+                        graph.transitive_effects(target):
+                    out.append(child)
+    return out
+
+
+def _stmt_index_of(branch: List[ast.stmt], node: ast.AST) -> int:
+    """Index of the top-level branch statement containing `node`."""
+    for i, stmt in enumerate(branch):
+        for child in ast.walk(stmt):
+            if child is node:
+                return i
+    return -1
+
+
+def check_servicer_protocol(path: str, tree: ast.Module,
+                            source_lines: Sequence[str],
+                            graph: ModuleGraph) -> List[Finding]:
+    """journal-before-ack + servicer half of idem-key-required."""
+    findings: List[Finding] = []
+    servicer_classes = {info.cls for info in graph.funcs.values()
+                        if info.cls and
+                        info.qualname.endswith("._journal")}
+    if not servicer_classes:
+        return findings
+    for info in graph.funcs.values():
+        if info.cls not in servicer_classes or \
+                info.qualname.endswith("._journal"):
+            continue
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.If):
+                continue
+            verbs = _isinstance_verb(node.test)
+            journaled = verbs & JOURNALED_VERBS
+            if not journaled:
+                continue
+            verb = sorted(journaled)[0]
+            branch = node.body
+            jcalls = _branch_journal_calls(branch, graph, info.cls)
+            if not jcalls:
+                if not is_suppressed(source_lines, node.lineno,
+                                     "journal-before-ack"):
+                    findings.append(Finding(
+                        "journal-before-ack",
+                        f"servicer branch for mutating verb {verb} "
+                        f"returns a response without any journal append "
+                        f"— a master restart silently loses the acked "
+                        f"mutation (route through self._journal)",
+                        path, node.lineno))
+                continue
+            # ordering: the last journal call must precede the branch's
+            # final return in top-level statement order
+            returns = [s for s in branch if isinstance(s, ast.Return)]
+            if returns:
+                last_ret = returns[-1]
+                j_idx = max(_stmt_index_of(branch, c) for c in jcalls)
+                r_idx = _stmt_index_of(branch, last_ret)
+                if 0 <= r_idx < j_idx and not is_suppressed(
+                        source_lines, last_ret.lineno,
+                        "journal-before-ack"):
+                    findings.append(Finding(
+                        "journal-before-ack",
+                        f"servicer branch for {verb} acks (line "
+                        f"{last_ret.lineno}) BEFORE its journal append — "
+                        f"append must precede the response frame",
+                        path, last_ret.lineno))
+            if verb in IDEM_VERBS:
+                carries = any(
+                    any(kw.arg == "idem" and not (
+                        isinstance(kw.value, ast.Constant)
+                        and kw.value.value is None)
+                        for kw in c.keywords)
+                    for c in jcalls)
+                if not carries and not is_suppressed(
+                        source_lines, node.lineno, "idem-key-required"):
+                    findings.append(Finding(
+                        "idem-key-required",
+                        f"servicer branch for {verb} journals without "
+                        f"idem= — a retry crossing a master restart "
+                        f"re-applies instead of replaying the recorded "
+                        f"response",
+                        path, node.lineno))
+    return findings
+
+
+# ------------------------------------------------- rule: client idem keys
+
+
+def check_client_idem(path: str, tree: ast.Module,
+                      source_lines: Sequence[str],
+                      graph: ModuleGraph) -> List[Finding]:
+    """Client half of idem-key-required: a method that ships an IDEM_VERB
+    payload must pass idem= into its transport call."""
+    findings: List[Finding] = []
+    for info in graph.funcs.values():
+        built_verbs: Set[str] = set()
+        for child in ast.walk(info.node):
+            if isinstance(child, ast.Call):
+                term = _terminal(child.func)
+                if term in IDEM_VERBS:
+                    built_verbs.add(term)
+        if not built_verbs:
+            continue
+        transport_calls = [
+            c for c in ast.walk(info.node)
+            if isinstance(c, ast.Call)
+            and _terminal(c.func) in CLIENT_TRANSPORT_CALLS]
+        if not transport_calls:
+            continue  # constructing a payload without sending is not ours
+        ok = any(any(kw.arg == "idem" and not (
+            isinstance(kw.value, ast.Constant) and kw.value.value is None)
+            for kw in c.keywords) for c in transport_calls)
+        if not ok:
+            line = transport_calls[0].lineno
+            if not is_suppressed(source_lines, line, "idem-key-required"):
+                findings.append(Finding(
+                    "idem-key-required",
+                    f"{info.qualname} sends mutating verb(s) "
+                    f"{sorted(built_verbs)} without idem= on the "
+                    f"transport call — pass idem=self._next_idem()",
+                    path, line))
+    return findings
+
+
+# ------------------------------------------------------ rule: commit-order
+
+
+def _writes_const(call: ast.Call, consts: Set[str]) -> bool:
+    term = _terminal(call.func)
+    if term not in ("write", "open", "write_fileobj"):
+        return False
+    # reads share the same callee names on storage objects — require a
+    # write-mode literal for open()
+    if term == "open":
+        mode = ""
+        if len(call.args) > 1 and isinstance(call.args[1], ast.Constant):
+            mode = str(call.args[1].value)
+        for kw in call.keywords:
+            if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                mode = str(kw.value.value)
+        if "w" not in mode and "a" not in mode:
+            return False
+    return bool(_names_in(call) & consts)
+
+
+def check_commit_order(path: str, tree: ast.Module,
+                       source_lines: Sequence[str],
+                       graph: ModuleGraph) -> List[Finding]:
+    findings: List[Finding] = []
+    for info in graph.funcs.values():
+        body = info.node.body
+        for child in ast.walk(info.node):
+            if not isinstance(child, ast.Call):
+                continue
+            is_marker = _writes_const(child, MARKER_CONSTS)
+            is_tracker = _writes_const(child, TRACKER_CONSTS)
+            if not (is_marker or is_tracker):
+                continue
+            # effects reachable from statements BEFORE this write
+            idx = _stmt_index_of(body, child)
+            prior: Set[str] = set()
+            for stmt in body[:idx + 1]:
+                for c in ast.walk(stmt):
+                    if isinstance(c, ast.Call):
+                        if c is child:
+                            continue
+                        if c.lineno > child.lineno:
+                            continue
+                        target = graph.resolve(c, info.cls)
+                        if target:
+                            prior |= graph.transitive_effects(target)
+                        term = _terminal(c.func)
+                        if term in MANIFEST_PUBLISHERS:
+                            prior.add("manifest-publish")
+                        if term in COMMIT_EVIDENCE:
+                            prior.add("commit-evidence")
+                        if term in ("exists", "read") and \
+                                _names_in(c) & (MARKER_CONSTS
+                                                | {"MANIFEST_NAME"}):
+                            prior.add("commit-evidence")
+                        if _writes_const(c, MARKER_CONSTS):
+                            prior.add("marker-write")
+            if is_marker and "manifest-publish" not in prior:
+                if not is_suppressed(source_lines, child.lineno,
+                                     "commit-order"):
+                    findings.append(Finding(
+                        "commit-order",
+                        f"{info.qualname} writes the .commit marker with "
+                        f"no preceding manifest publish — the commit "
+                        f"order is done-files -> manifest -> marker -> "
+                        f"tracker",
+                        path, child.lineno))
+            if is_tracker and not prior & {"manifest-publish",
+                                           "commit-evidence",
+                                           "marker-write"}:
+                if not is_suppressed(source_lines, child.lineno,
+                                     "commit-order"):
+                    findings.append(Finding(
+                        "commit-order",
+                        f"{info.qualname} publishes the tracker with no "
+                        f"preceding manifest publish or commit evidence "
+                        f"— it may point at an unverifiable generation",
+                        path, child.lineno))
+    return findings
+
+
+# ---------------------------------------------------- rule: atomic-publish
+
+
+def _resolved_path_text(call: ast.Call, info: FuncInfo) -> str:
+    """Source-ish text of open()'s path arg, chasing one local assign."""
+    if not call.args:
+        return ""
+    arg = call.args[0]
+    texts = [ast.dump(arg)]
+    if isinstance(arg, ast.Name):
+        for child in ast.walk(info.node):
+            if isinstance(child, ast.Assign):
+                for t in child.targets:
+                    if isinstance(t, ast.Name) and t.id == arg.id:
+                        texts.append(ast.dump(child.value))
+            elif isinstance(child, ast.AugAssign):
+                t = child.target
+                if isinstance(t, ast.Name) and t.id == arg.id:
+                    texts.append(ast.dump(child.value))
+    return " ".join(texts)
+
+
+def check_atomic_publish(path: str, tree: ast.Module,
+                         source_lines: Sequence[str],
+                         graph: ModuleGraph) -> List[Finding]:
+    norm = path.replace(os.sep, "/")
+    if any(norm.endswith(f) for f in ATOMIC_HELPER_FILES):
+        return []
+    findings: List[Finding] = []
+    for info in graph.funcs.values():
+        for child in ast.walk(info.node):
+            if not (isinstance(child, ast.Call)
+                    and isinstance(child.func, ast.Name)
+                    and child.func.id == "open"):
+                continue
+            mode = ""
+            if len(child.args) > 1 and isinstance(child.args[1],
+                                                  ast.Constant):
+                mode = str(child.args[1].value)
+            for kw in child.keywords:
+                if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                    mode = str(kw.value.value)
+            if "w" not in mode and "a" not in mode:
+                continue
+            text = _resolved_path_text(child, info).lower()
+            if "tmp" in text:
+                continue  # write-tmp half of the sanctioned dance
+            if not any(h in text for h in PUBLISHED_HINTS):
+                continue
+            if is_suppressed(source_lines, child.lineno, "atomic-publish"):
+                continue
+            findings.append(Finding(
+                "atomic-publish",
+                f"{info.qualname} writes a published control file with a "
+                f"raw open(..., {mode!r}) — a crash mid-write publishes "
+                f"a torn file; route through storage.write (write-tmp + "
+                f"fsync + rename) or write a .tmp and os.replace it",
+                path, child.lineno))
+    return findings
+
+
+# -------------------------------------------------------- rule: lock-leak
+
+
+def check_lock_leak(path: str, tree: ast.Module,
+                    source_lines: Sequence[str],
+                    graph: ModuleGraph) -> List[Finding]:
+    norm = path.replace(os.sep, "/")
+    if any(norm.endswith(f) for f in LOCK_IMPL_FILES):
+        return []
+    findings: List[Finding] = []
+    for info in graph.funcs.values():
+        acquires: List[Tuple[str, ast.Call]] = []
+        released_in_finally: Set[str] = set()
+        for child in ast.walk(info.node):
+            if isinstance(child, ast.Call):
+                term = _terminal(child.func)
+                obj = _dotted(child.func)
+                if term == "acquire" and obj and \
+                        "lock" in obj.lower():
+                    acquires.append((obj.rsplit(".", 1)[0], child))
+            if isinstance(child, ast.Try):
+                for stmt in child.finalbody:
+                    for c in ast.walk(stmt):
+                        if isinstance(c, ast.Call) and \
+                                _terminal(c.func) == "release":
+                            obj = _dotted(c.func)
+                            if obj:
+                                released_in_finally.add(
+                                    obj.rsplit(".", 1)[0])
+        for obj, call in acquires:
+            if obj in released_in_finally:
+                continue
+            if is_suppressed(source_lines, call.lineno, "lock-leak"):
+                continue
+            findings.append(Finding(
+                "lock-leak",
+                f"{info.qualname} acquires {obj} without a release in a "
+                f"finally — a crash mid-section leaves the cross-process "
+                f"lock held until the dead-pid reaper notices (pattern: "
+                f"acquire, then try: ... finally: release)",
+                path, call.lineno))
+    return findings
+
+
+# ------------------------------------------------------------- entry point
+
+
+CHECKS = (
+    check_servicer_protocol,
+    check_client_idem,
+    check_commit_order,
+    check_atomic_publish,
+    check_lock_leak,
+)
+
+
+def run_paths(paths: Sequence[str],
+              checkers: Optional[Sequence[str]] = None
+              ) -> Tuple[List[Finding], int]:
+    """Run the protocol engine over files/dirs; (findings, files_scanned).
+
+    Same contract as ast_engine.run_paths; `checkers` filters by rule id
+    (a check function contributes when ANY of its rule ids is selected —
+    check_servicer_protocol emits two ids).
+    """
+    from .ast_engine import iter_python_files
+
+    wanted = set(checkers) if checkers else None
+    files = iter_python_files(paths)
+    findings: List[Finding] = []
+    for fpath in files:
+        try:
+            source = open(fpath).read()
+            tree = ast.parse(source)
+        except (OSError, SyntaxError) as e:
+            findings.append(Finding("parse-error", str(e), fpath, 0))
+            continue
+        lines = source.splitlines()
+        rel = os.path.relpath(fpath)
+        graph = ModuleGraph(tree)
+        _mark_effects(graph)
+        for check in CHECKS:
+            got = check(rel, tree, lines, graph)
+            if wanted is not None:
+                got = [f for f in got if f.checker in wanted]
+            findings.extend(got)
+    return findings, len(files)

@@ -239,6 +239,13 @@ class WarmPool:
 
     # -------------------------------------------------------- launching
 
+    def _publish(self, path: str, content: str) -> None:
+        """Atomic write of a pool control file (write-tmp + rename)."""
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(content)
+        os.replace(tmp, path)
+
     def warm_async(self, spec: WarmSpec) -> Optional[subprocess.Popen]:
         """Launch one background compile; None when deduped (already
         ready, or a live inflight marker exists)."""
@@ -252,10 +259,13 @@ class WarmPool:
                     < _INFLIGHT_TTL_S:
                 return None
             spec_path = os.path.join(self.pool, f"{skey}.spec.json")
-            with open(spec_path, "w") as f:
-                f.write(spec.to_json())
-            with open(inflight, "w") as f:
-                f.write(str(os.getpid()))
+            # both files are read by other processes (the compile child
+            # re-derives its platform from the spec; concurrent warmers
+            # dedupe on the inflight marker) — publish atomically so a
+            # crash mid-write never leaves a torn spec or a marker whose
+            # mtime lies about a write still in progress
+            self._publish(spec_path, spec.to_json())
+            self._publish(inflight, str(os.getpid()))
         except OSError:
             logger.warning("warm pool dir not writable", exc_info=True)
             return None

@@ -862,7 +862,7 @@ def ckpt_corrupt(timeout: float = 180.0) -> Dict:
         shm.mark_empty()
         from .common.constants import CheckpointConstant
 
-        with open(os.path.join(ckpt_dir,
+        with open(os.path.join(ckpt_dir,  # graftlint: disable=commit-order,atomic-publish -- drill forges a stale tracker on purpose
                                CheckpointConstant.TRACKER_FILE), "w") as f:
             f.write("2")  # retention ate checkpoint-2; tracker is stale
         restored = ck.load_checkpoint(template)

@@ -385,7 +385,7 @@ class TestDonatedReuse:
             tmp_path, "tests/test_x.py", """\
             def test_step(res, state, batch):
                 s, m = res.train_step(state, batch)
-                return state  # graftlint: disable=donated-reuse
+                return state  # graftlint: disable=donated-reuse -- fixture: suppression honored
             """)
         assert found == []
 
@@ -501,7 +501,7 @@ class TestBlockingReadback:
             def run(res, state, batch, n):
                 for _ in range(n):
                     state, m = res.train_step(state, batch)
-                    float(m["loss"])  # graftlint: disable=blocking-readback
+                    float(m["loss"])  # graftlint: disable=blocking-readback -- fixture: suppression honored
                 return state
             """)
         assert found == []
@@ -595,7 +595,7 @@ class TestRawRpcCall:
             import socket
 
             def ping(addr):
-                return socket.create_connection(addr)  # graftlint: disable=raw-rpc-call
+                return socket.create_connection(addr)  # graftlint: disable=raw-rpc-call -- fixture: suppression honored
             """)
         assert found == []
 
@@ -664,7 +664,7 @@ class TestUnverifiedRestore:
 
             def resume(handler, sharding):
                 step, flat, metas, extra = handler.load_state_dict()
-                return jax.device_put(flat["w"], sharding)  # graftlint: disable=unverified-restore
+                return jax.device_put(flat["w"], sharding)  # graftlint: disable=unverified-restore -- fixture: suppression honored
             """
         assert _scan_source(tmp_path, "pkg/tests/test_x.py", src) == []
         assert _scan_source(tmp_path, "pkg/ckpt/sanctioned.py", src) == []
@@ -744,7 +744,8 @@ class TestDocstringCitation:
 class TestFindings:
     def test_format_and_summary(self):
         f = Finding("env-at-trace", "boom", "a/b.py", 7)
-        assert f.format() == "a/b.py:7: [env-at-trace] boom"
+        # v2: severity (catalog-defaulted) rides between location and rule
+        assert f.format() == "a/b.py:7: error: [env-at-trace] boom"
         assert summarize([f, f, Finding("remat-noop", "x")]) == {
             "env-at-trace": 2, "remat-noop": 1}
         assert "and 1 more" in render_report([f, f, f], limit=2)

@@ -70,6 +70,9 @@ def test_preempt_goodput_at_tuned_interval():
     assert len(r["kills"]) == 2, r
 
 
+@pytest.mark.slow  # tier-2: ~37s wall-clock goodput drill; preempt goodput
+# is tier-1 via test_preempt_goodput_at_tuned_interval and fused-boundary
+# equivalence via test_fused_steps
 def test_preempt_fused_boundaries_keep_goodput():
     """Fused K-step dispatch (ISSUE 3): shm staging, disk saves and
     recovery fire at fusion boundaries ONLY, quantizing the loss per

@@ -144,14 +144,14 @@ def _lock_and_die_worker(lock_name, out_name):
     # worker SIGKILLed inside its shm-staging critical section
     lock = SharedLock(lock_name, master=False)
     q_out = SharedQueue(out_name, master=False)
-    assert lock.acquire(timeout=10)
+    assert lock.acquire(timeout=10)  # graftlint: disable=lock-leak -- the un-released acquire IS the scenario under test
     q_out.put("held")
 
 
 class TestIpc:
     def test_shared_lock_same_process(self):
         lock = SharedLock("t1", master=True)
-        assert lock.acquire()
+        assert lock.acquire()  # graftlint: disable=lock-leak -- single-process semantics test, released two lines down
         assert lock.locked()
         lock.release()
         assert not lock.locked()
@@ -171,7 +171,7 @@ class TestIpc:
         proc.join(timeout=10)
         assert lock.locked()  # the dead holder left it held
         t0 = time.time()
-        assert lock.acquire(timeout=30)  # reaped, not waited out
+        assert lock.acquire(timeout=30)  # reaped, not waited out  # graftlint: disable=lock-leak -- reap-semantics test, released below
         assert time.time() - t0 < 5.0
         lock.release()
         lock.close()
@@ -179,8 +179,8 @@ class TestIpc:
 
     def test_shared_lock_does_not_reap_live_holder(self):
         lock = SharedLock("t1-live", master=True)
-        assert lock.acquire()  # holder: this (live) process
-        assert not lock.acquire(blocking=False)
+        assert lock.acquire()  # holder: this (live) process  # graftlint: disable=lock-leak -- live-holder semantics test, released below
+        assert not lock.acquire(blocking=False)  # graftlint: disable=lock-leak -- must FAIL to acquire; nothing to release
         assert lock.locked()
         lock.release()
         lock.close()

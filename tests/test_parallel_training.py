@@ -395,6 +395,10 @@ class TestSelectiveRematPolicies:
             dict(res.state.params))
         return float(loss), jax.device_get(grads)
 
+    # tier-2: ~34s three-policy gradient sweep; policy plumbing is
+    # tier-1 via test_policy_threads_into_model_config and remat
+    # correctness via the jaxpr-engine remat-noop gate
+    @pytest.mark.slow
     def test_policies_match_no_remat_gradients(self):
         base_loss, base_grads = self._loss_and_grads(
             [("fsdp", {}), ("checkpoint", {"enabled": False})])

@@ -52,6 +52,10 @@ def _run_fit(n_dev: int, config: dict, timeout: float = 540.0) -> dict:
 
 
 class TestScale8B:
+    # tier-2: ~190s AOT compile; the analytic fit bounds are asserted by
+    # the fast TestScaleAbstract siblings, and tools/scale_fit.py runs
+    # this compile on demand
+    @pytest.mark.slow
     def test_fsdp16_remat_dots_compiles_and_fits(self):
         """Full Llama-8B train step, fsdp16, remat dots, seq 4096."""
         r = _run_fit(16, {

@@ -366,6 +366,8 @@ def test_kill_remesh_served_from_warm_pool(tmp_path):
     assert stats["pool_hits"] >= 1 and stats["warm_hits"] >= 1, stats
 
 
+@pytest.mark.slow  # tier-2: ~33s two-drill A/B; warm-pool serving is
+# tier-1 via test_kill_remesh_served_from_warm_pool
 def test_preempt_drill_reports_compile_saved(tmp_path):
     """chaos preempt with model=True: warm run (persistent cache) vs
     cold control — the downtime split shows a NONZERO compile_s saved
