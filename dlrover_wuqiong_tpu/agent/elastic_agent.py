@@ -119,13 +119,20 @@ class ElasticAgent:
 
         Parity: reference MasterRendezvousHandler.next_rendezvous (:250).
         """
+        from ..telemetry import spans as tspans
+
+        with tspans.span(f"rdzv:{name}:join", {"node": self.node_id}) as rec:
+            out = self._rendezvous_poll(name, rec)
+        return out
+
+    def _rendezvous_poll(self, name: str, span_rec) -> RendezvousOutcome:
         free_port = find_free_port()
         self.mc.join_rendezvous(
             self.node_rank, self.config.nproc_per_node, rdzv_name=name,
             node_ip=os.getenv("DWT_NODE_IP", "127.0.0.1"),
             free_port=free_port)
-        deadline = time.time() + self.config.rdzv_timeout
-        while time.time() < deadline:
+        deadline = time.monotonic() + self.config.rdzv_timeout
+        while time.monotonic() < deadline:
             state = self.mc.get_comm_world(rdzv_name=name)
             if state.complete and state.rdzv_round <= \
                     self._last_rdzv_round.get(name, -1):
@@ -151,6 +158,8 @@ class ElasticAgent:
                         free_port=free_port)
                     continue
                 self._last_rdzv_round[name] = state.rdzv_round
+                span_rec["attrs"]["round"] = state.rdzv_round
+                span_rec["attrs"]["world"] = total_procs
                 return RendezvousOutcome(
                     state.rdzv_round, my_rank, total_procs,
                     state.coordinator_addr, self.config.nproc_per_node)
@@ -236,6 +245,13 @@ class ElasticAgent:
             NodeEnv.LOCAL_DEVICE_COUNT: str(outcome.local_world_size),
             NodeEnv.RESTART_COUNT: str(self._restart_count),
         })
+        # trace context crosses the process boundary via env: the worker's
+        # spans (restore tiers, rpc verbs) parent under this agent's trace
+        from ..telemetry import spans as tspans
+
+        with tspans.env_context() as trace_env:
+            env.update(trace_env)
+        env.setdefault("DWT_PROC_ROLE", "trainer")
         # one compile-cache dir across worker generations and warm
         # children: the restarted worker must read what the pool wrote
         from ..auto.compile_cache import default_cache_dir
@@ -363,8 +379,21 @@ class ElasticAgent:
 
     # --------------------------------------------------------------- run loop
 
+    def _flush_flight(self, reason: str):
+        """Dump the flight-recorder ring next to the checkpoints (best
+        effort — the saver's latest persist path is the anchor)."""
+        from ..telemetry.recorder import get_recorder
+
+        path = (getattr(self._saver, "_latest_path", "") or
+                os.getenv("DWT_CKPT_DIR", ""))
+        if path:
+            get_recorder().flush(path, reason)
+
     def run(self) -> int:
         """Supervisor loop. Parity: reference `_invoke_run` (:580)."""
+        from ..telemetry import spans as tspans
+
+        tspans.set_process_role("agent")
         self._start_saver()
         self._start_heartbeat()
         from .config_tuner import ParalConfigTuner
@@ -406,6 +435,7 @@ class ElasticAgent:
                 continue
             # failure path
             logger.warning("worker failed with exit code %s", exit_code)
+            self._flush_flight("worker-fault")
             if self._saver is not None:
                 try:
                     self._saver.save_shm_to_storage()
@@ -464,8 +494,8 @@ class ElasticAgent:
 
             cache_dir = os.getenv(NodeEnv.COMPILE_CACHE_DIR,
                                   default_cache_dir())
-            deadline = time.time() + spec_wait_s
-            while time.time() < deadline and not self._stopped.is_set() \
+            deadline = time.monotonic() + spec_wait_s
+            while time.monotonic() < deadline and not self._stopped.is_set() \
                     and generation == self._warm_generation:
                 spec = load_current_spec(cache_dir)
                 # only a spec from THIS world: a stale file from the

@@ -113,8 +113,15 @@ class MasterClient:
     def _call_critical(self, verb: str, payload, idem: Optional[str] = None):
         """Blocking control-plane verb: ride a master outage with backoff
         up to the grace deadline, then raise MasterUnreachableError."""
-        resp = self._client._call(  # noqa: SLF001 — typed facade over _call
-            verb, payload, idem=idem, deadline_s=self._outage_grace_s)
+        t0 = time.monotonic()
+        try:
+            resp = self._client._call(  # noqa: SLF001 — typed facade
+                verb, payload, idem=idem, deadline_s=self._outage_grace_s)
+        except MasterUnreachableError:
+            # wall time burned blocking on a dead master is the
+            # master-outage-degraded ledger split (telemetry/ledger.py)
+            self._account_degraded(time.monotonic() - t0)
+            raise
         self._maybe_flush()
         return resp
 
@@ -123,10 +130,12 @@ class MasterClient:
         a short retry, then the frame parks in the bounded buffer (oldest
         dropped) and `default` is returned; the buffer drains on the next
         successful call (reconnect or new master)."""
+        t0 = time.monotonic()
         try:
             resp = self._client._call(  # noqa: SLF001
                 "report", payload, attempts=2)
         except MasterUnreachableError:
+            self._account_degraded(time.monotonic() - t0)
             with self._buffer_lock:
                 if len(self._buffer) >= self.BUFFER_CAP:
                     self._buffer.popleft()
@@ -136,6 +145,18 @@ class MasterClient:
             return default
         self._maybe_flush()
         return resp
+
+    @staticmethod
+    def _account_degraded(seconds: float):
+        """Credit retry time burned against an unreachable master; only
+        seconds actually spent blocked count — training that continues
+        through the outage stays productive in the ledger."""
+        try:
+            from ..telemetry.ledger import get_ledger
+
+            get_ledger().account("degraded", seconds)
+        except Exception:  # noqa: BLE001 — telemetry must never break rpc
+            pass
 
     def _call_polling(self, verb: str, payload):
         """Advisory verb on a caller-owned cadence: fail fast (the caller's
@@ -330,6 +351,25 @@ class MasterClient:
         master's exported metric registry."""
         return self._call_buffered(msg.CustomMetric(data=dict(data)),
                                    default=msg.OkResponse())
+
+    def report_goodput_ledger(self, snapshot: Dict):
+        """Push a cumulative ledger snapshot (telemetry/ledger.py
+        ``GoodputLedger.snapshot()``) — BUFFERED: cumulative totals make
+        drops and replays harmless (master keeps latest per node)."""
+        return self._call_buffered(
+            msg.GoodputLedgerReport(
+                node_id=self.node_id,
+                wall_s=float(snapshot.get("wall_s", 0.0)),
+                states={str(k): float(v)
+                        for k, v in snapshot.get("states", {}).items()},
+                other_s=float(snapshot.get("other_s", 0.0)),
+                goodput_fraction=float(
+                    snapshot.get("goodput_fraction", 0.0))),
+            default=msg.OkResponse())
+
+    def get_goodput_summary(self) -> msg.GoodputSummary:
+        """Job-level ledger aggregation (tools/goodput_report.py)."""
+        return self._call_polling("get", msg.GoodputQuery())
 
     def report_diagnosis(self, payload_type: str,
                          content: str) -> msg.DiagnosisAction:

@@ -17,14 +17,35 @@ from .master_client import MasterClient
 
 logger = get_logger("monitor")
 
+_PROC = None
+_PROC_LOCK = threading.Lock()
+
+
+def _psutil_process():
+    """Cached, PRIMED psutil.Process.
+
+    `cpu_percent(interval=None)` measures since the previous call on the
+    same Process object — the first call has no baseline and always
+    returns 0.0.  A fresh Process per report (the old code) therefore
+    reported a flat 0% CPU forever.  Prime once at acquisition and reuse;
+    re-acquire after fork/spawn (pid check) so a child never reads the
+    parent's baseline."""
+    global _PROC
+    import psutil
+
+    with _PROC_LOCK:
+        if _PROC is None or _PROC.pid != os.getpid():
+            proc = psutil.Process()
+            proc.cpu_percent(interval=None)  # prime the baseline sample
+            _PROC = proc
+        return _PROC
+
 
 def get_process_resource() -> Dict[str, float]:
     """Host usage of this process tree (no psutil dependency required)."""
     stats: Dict[str, float] = {"cpu_percent": 0.0, "memory_mb": 0.0}
     try:
-        import psutil
-
-        proc = psutil.Process()
+        proc = _psutil_process()
         stats["cpu_percent"] = proc.cpu_percent(interval=None)
         stats["memory_mb"] = proc.memory_info().rss / (1 << 20)
     except ImportError:

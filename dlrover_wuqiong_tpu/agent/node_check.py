@@ -40,9 +40,9 @@ def matmul_benchmark(size: int = 2048, rounds: int = 8) -> float:
         return out
 
     chain(x).block_until_ready()  # warmup/compile
-    t0 = time.time()
+    t0 = time.monotonic()
     chain(x).block_until_ready()
-    return time.time() - t0
+    return time.monotonic() - t0
 
 
 def allgather_benchmark(nbytes: int = 1 << 24) -> float:
@@ -58,9 +58,9 @@ def allgather_benchmark(nbytes: int = 1 << 24) -> float:
         # single chip: time a HBM round-trip instead
         x = jnp.ones((nbytes // 4,), jnp.float32)
         y = jax.device_put(x)
-        t0 = time.time()
+        t0 = time.monotonic()
         jax.device_get(y)
-        return time.time() - t0
+        return time.monotonic() - t0
     mesh = Mesh(np.array(devices), ("x",))
     per = nbytes // 4 // n * n
     x = jax.device_put(
@@ -73,9 +73,9 @@ def allgather_benchmark(nbytes: int = 1 << 24) -> float:
             x, NamedSharding(mesh, P(None)))
 
     gather(x).block_until_ready()
-    t0 = time.time()
+    t0 = time.monotonic()
     gather(x).block_until_ready()
-    return time.time() - t0
+    return time.monotonic() - t0
 
 
 def run_check_workload(matmul_size: int = 2048) -> Tuple[bool, float]:
@@ -106,8 +106,8 @@ def run_network_check(agent, rounds: int = 2,
         outcome = agent.rendezvous(name=RendezvousName.NETWORK_CHECK)
         healthy, elapsed = run_check_workload()
         agent.mc.report_network_check_result(healthy, elapsed)
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
             success, reason = agent.mc.network_check_success()
             if success:
                 break

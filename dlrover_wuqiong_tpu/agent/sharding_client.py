@@ -36,11 +36,11 @@ class ShardingClient:
 
     def fetch_shard(self, wait: bool = True, timeout: float = 600.0):
         """Returns a Task with a shard, or None when the dataset is finished."""
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout
         while True:
             task = self._mc.get_task(self.dataset_name)
             if task.task_type == "wait":
-                if not wait or time.time() > deadline:
+                if not wait or time.monotonic() > deadline:
                     return None
                 time.sleep(0.5)
                 continue

@@ -111,7 +111,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(json.dumps({"graftlint_catalog": catalog_json()}))
         return 0
 
-    t0 = time.time()
+    t0 = time.monotonic()
     findings = []
     engines = []
     files_scanned = 0
@@ -167,7 +167,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             "hlo_collectives": {
                 tag: {op: dict(v) for op, v in sorted(ops.items())}
                 for tag, ops in sorted(hlo_measured.items())},
-            "elapsed_s": round(time.time() - t0, 2),
+            "elapsed_s": round(time.monotonic() - t0, 2),
             "ok": not gating,
         }
     }))

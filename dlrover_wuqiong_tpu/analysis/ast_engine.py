@@ -707,6 +707,69 @@ def check_docstring_citation(path: str, tree: ast.Module,
         path, 1, rule="every module docstring cites its reference")]
 
 
+# ------------------------------------------------ wall-clock-duration
+
+#: arithmetic against a file timestamp is wall-to-wall by necessity
+#: (mtimes are wall clock) — exempt, the comparison is correct as is
+_WALL_EXEMPT_CALLEES = ("getmtime", "getctime", "getatime",
+                        "st_mtime", "st_ctime", "st_atime")
+
+
+def _is_wall_clock_call(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and _dotted(node.func) in ("time.time", "_time.time"))
+
+
+def _touches_file_timestamp(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        name = ""
+        if isinstance(n, ast.Attribute):
+            name = n.attr
+        elif isinstance(n, ast.Name):
+            name = n.id
+        if name in _WALL_EXEMPT_CALLEES:
+            return True
+    return False
+
+
+def check_wall_clock_duration(path: str, tree: ast.Module,
+                              source_lines: Sequence[str]
+                              ) -> List[Finding]:
+    """``time.time()`` inside elapsed-time / deadline arithmetic.
+
+    Wall clock steps under NTP slew and host suspend; a deadline computed
+    as ``time.time() + timeout`` or an interval as ``time.time() - t0``
+    can fire early, late, or negative.  Duration math belongs on
+    ``time.monotonic()``.  ``time.time()`` stays correct for PERSISTED /
+    cross-process timestamps (journal entries, manifest ``ts`` fields,
+    file-mtime comparisons) — those sites carry a suppression with the
+    reason, or compare against a file timestamp (auto-exempt).
+    """
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.BinOp) or \
+                not isinstance(node.op, (ast.Add, ast.Sub)):
+            continue
+        line = getattr(node, "lineno", 0)
+        if _suppressed(source_lines, line, "wall-clock-duration"):
+            continue
+        sides = (node.left, node.right)
+        if not any(_is_wall_clock_call(s) for s in sides):
+            continue
+        if any(_touches_file_timestamp(s) for s in sides):
+            continue
+        op = "+" if isinstance(node.op, ast.Add) else "-"
+        findings.append(Finding(
+            "wall-clock-duration",
+            f"time.time() used in `{op}` arithmetic — elapsed/deadline "
+            f"math on the wall clock drifts under NTP slew; use "
+            f"time.monotonic() (keep time.time() only for persisted or "
+            f"cross-process timestamps, with a suppression reason)",
+            path, line,
+            rule="duration math runs on the monotonic clock"))
+    return findings
+
+
 # ------------------------------------------------------------- driver
 
 
@@ -761,6 +824,8 @@ def run_paths(paths: Sequence[str],
                 check_control_plane_hygiene(rel, tree, lines))
         if not checkers or "docstring-citation" in checkers:
             findings.extend(check_docstring_citation(rel, tree, lines))
+        if not checkers or "wall-clock-duration" in checkers:
+            findings.extend(check_wall_clock_duration(rel, tree, lines))
         if not checkers or "suppression-no-reason" in checkers:
             from .findings import check_suppression_reasons
 

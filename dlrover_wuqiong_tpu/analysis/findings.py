@@ -188,6 +188,13 @@ RULE_CATALOG: Dict[str, Dict[str, str]] = {
                      "file:line it matches so behavior parity stays "
                      "auditable",
     },
+    "wall-clock-duration": {
+        "engine": "ast", "severity": "warning",
+        "rationale": "time.time() in elapsed/deadline arithmetic drifts "
+                     "under NTP slew and host suspend — duration math runs "
+                     "on time.monotonic(); wall clock is only for "
+                     "persisted or cross-process timestamps",
+    },
     # ---- protocol engine (interprocedural, per-module call graph)
     "journal-before-ack": {
         "engine": "protocol", "severity": "error",

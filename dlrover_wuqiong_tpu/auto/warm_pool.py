@@ -306,10 +306,10 @@ class WarmPool:
 
     def wait(self, timeout: float = 600.0) -> bool:
         """Block until launched children exit; True when all succeeded."""
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout
         ok = True
         for proc in self._children:
-            remaining = max(0.1, deadline - time.time())
+            remaining = max(0.1, deadline - time.monotonic())
             try:
                 ok = (proc.wait(timeout=remaining) == 0) and ok
             except subprocess.TimeoutExpired:
@@ -456,7 +456,10 @@ def _child_main(spec_path: str) -> int:
     pool = pool_dir(cache_dir)
     skey = spec.spec_key()
     inflight = os.path.join(pool, f"{skey}.inflight")
-    t0 = time.time()
+    t0 = time.monotonic()  # duration math; entry "ts" stays wall-clock
+    from ..telemetry import spans as tspans
+
+    tspans.set_process_role("warm-pool")
     try:
         import jax.numpy as jnp
         import optax
@@ -492,7 +495,9 @@ def _child_main(spec_path: str) -> int:
               "labels": jax.ShapeDtypeStruct(shape, jnp.int32,
                                              sharding=bsh)}
         h0, m0 = counters.snapshot()
-        res.train_step.lower(res.state, ab).compile()
+        with tspans.span("warm:hydrate", {"spec": skey,
+                                          "n_devices": spec.n_devices}):
+            res.train_step.lower(res.state, ab).compile()
         h1, m1 = counters.snapshot()
         entry = {
             "spec_key": skey,
@@ -501,7 +506,7 @@ def _child_main(spec_path: str) -> int:
             "mesh": res.strategy.plan.describe(),
             "platform": spec.platform,
             "fused_steps": fused,
-            "compile_s": round(time.time() - t0, 2),
+            "compile_s": round(time.monotonic() - t0, 2),
             "already_cached": (h1 - h0) > 0 and (m1 - m0) == 0,
             "ready": True,
             "ts": time.time(),

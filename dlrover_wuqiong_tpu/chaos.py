@@ -137,13 +137,13 @@ def pod_kill(kill_at_step: int = 8, total_steps: int = 20,
     cli, work, ckpt_dir, marker, job = _launch_standalone(
         "chaos", _POD_KILL_WORKER, [total_steps], max_restarts=2)
 
-    deadline = time.time() + timeout
+    deadline = time.monotonic() + timeout
     killed_pid = None
     killed_at = -1  # the step actually OBSERVED when the kill landed —
     # polling can overshoot kill_at_step on a loaded host, so invariants
     # bound against this, not the request
     progress = os.path.join(marker, "progress")
-    while time.time() < deadline and killed_pid is None:
+    while time.monotonic() < deadline and killed_pid is None:
         try:
             seen = int(open(progress).read())
             if seen >= kill_at_step:
@@ -163,7 +163,8 @@ def pod_kill(kill_at_step: int = 8, total_steps: int = 20,
             pass
         time.sleep(0.05)
     try:
-        out, _ = cli.communicate(timeout=max(5.0, deadline - time.time()))
+        out, _ = cli.communicate(
+            timeout=max(5.0, deadline - time.monotonic()))
     except subprocess.TimeoutExpired:
         cli.kill()
         out, _ = cli.communicate()
@@ -278,11 +279,11 @@ def network_partition(heartbeat_timeout: float = 1.5,
             node = jm.register_node("worker", nid, rank_index=nid)
             node.update_status(NodeStatus.RUNNING)
             node.heartbeat_time = time.time()
-        t0 = time.time()
+        t0 = time.monotonic()
         relaunched = []
         # node 1 goes silent; node 0 keeps beating — the master's dead-node
         # sweep (master.py run loop) is replayed here
-        while time.time() - t0 < wait and not relaunched:
+        while time.monotonic() - t0 < wait and not relaunched:
             jm.get_node(0).heartbeat_time = time.time()
             for n in jm.get_dead_nodes():
                 relaunched.append(n.id)
@@ -311,6 +312,7 @@ import numpy as np
 from dlrover_wuqiong_tpu.trainer.elastic import init_elastic
 from dlrover_wuqiong_tpu.checkpoint.checkpointer import (
     FlashCheckpointer, StorageType)
+from dlrover_wuqiong_tpu.telemetry import get_ledger
 
 (ckpt_dir, marker_dir, total_steps, dt, interval, flash, with_model,
  fused) = (
@@ -319,8 +321,25 @@ from dlrover_wuqiong_tpu.checkpoint.checkpointer import (
     int(sys.argv[8]))
 ctx = init_elastic()
 restart = ctx.world.restart_count
-timing = {"restart": restart, "compile_s": 0.0, "restore_s": 0.0,
-          "cache_warm": False, "step_hits": 0, "step_misses": 0}
+# the downtime split comes from the GOODPUT LEDGER, not ad-hoc timers:
+# compile / restore_* / productive / rework are credited by the same
+# call sites production uses (telemetry/ledger.py); the drill only adds
+# cache counters the ledger does not model
+led = get_ledger()
+led.start()
+extra = {"restart": restart, "cache_warm": False,
+         "step_hits": 0, "step_misses": 0}
+ledger_path = os.path.join(marker_dir, f"ledger_r{restart}.json")
+
+
+def dump_ledger():
+    snap = dict(led.snapshot(), **extra)
+    tmp = ledger_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(snap, f)
+    os.replace(tmp, ledger_path)  # a SIGKILL mid-write must not tear it
+
+
 if with_model:
     # the re-mesh cost under measurement: rebuild + compile the REAL
     # train step through the persistent cache (auto/compile_cache.py) —
@@ -337,39 +356,49 @@ if with_model:
     cfg = dataclasses.replace(GPTConfig.nano(), dtype=jnp.float32,
                               use_flash_attention=False, remat=False)
     h0, m0 = counters.snapshot()
-    t0 = time.time()
-    res = auto_accelerate(GPT(cfg), optimizer=optax.adam(1e-2),
-                          devices=jax.devices(), strategy=[("fsdp", {})])
-    # batch sized by the inherited device count: under pytest the worker
-    # sees the conftest's 8-device XLA_FLAGS and fsdp needs B % n == 0
-    bs = max(4, len(jax.devices()))
-    data = np.random.default_rng(0).integers(
-        0, cfg.vocab_size, (bs, 33)).astype(np.int32)
-    hb = {"input_ids": data[:, :-1], "labels": data[:, 1:]}
-    if fused > 1:
-        # the re-mesh cost a FUSED worker pays: K changes the HLO, so
-        # this is its own cache entry (auto/compile_cache.py)
-        from dlrover_wuqiong_tpu.data.elastic_dataset import stack_batches
-        fb = res.place_fused_batch(stack_batches([hb] * fused))
-        st, m = res.fused_train_step(fused)(res.state, fb)
-    else:
-        b = res.place_batch(dict(hb))
-        st, m = res.train_step(res.state, b)
-    float(m["loss"])  # force the compile + first dispatch
+    with led.window("compile"):
+        res = auto_accelerate(GPT(cfg), optimizer=optax.adam(1e-2),
+                              devices=jax.devices(),
+                              strategy=[("fsdp", {})])
+        # batch sized by the inherited device count: under pytest the
+        # worker sees the conftest's 8-device XLA_FLAGS and fsdp needs
+        # B % n == 0
+        bs = max(4, len(jax.devices()))
+        data = np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (bs, 33)).astype(np.int32)
+        hb = {"input_ids": data[:, :-1], "labels": data[:, 1:]}
+        if fused > 1:
+            # the re-mesh cost a FUSED worker pays: K changes the HLO,
+            # so this is its own cache entry (auto/compile_cache.py)
+            from dlrover_wuqiong_tpu.data.elastic_dataset import (
+                stack_batches)
+            fb = res.place_fused_batch(stack_batches([hb] * fused))
+            st, m = res.fused_train_step(fused)(res.state, fb)
+        else:
+            b = res.place_batch(dict(hb))
+            st, m = res.train_step(res.state, b)
+        float(m["loss"])  # force the compile + first dispatch
     h1, m1 = counters.snapshot()
-    timing.update(compile_s=round(time.time() - t0, 3),
-                  cache_warm=res.cache_warm, step_hits=h1 - h0,
-                  step_misses=m1 - m0)
+    extra.update(cache_warm=res.cache_warm, step_hits=h1 - h0,
+                 step_misses=m1 - m0)
 ckpt = FlashCheckpointer(ckpt_dir, job_name=os.environ["DWT_JOB_NAME"])
 template = {"w": np.zeros((8, 8), np.float32),
             "step": np.zeros((), np.int64)}
-t0 = time.time()
+# restore_* tiers are credited INSIDE engine.load (the sanctioned
+# verified-restore route) — nothing to time here
 state = ckpt.load_checkpoint(template)
-timing["restore_s"] = round(time.time() - t0, 3)
 start = int(state["step"]) + 1 if state is not None else 0
-timing["start_step"] = start
-with open(os.path.join(marker_dir, f"timing_r{restart}.json"), "w") as f:
-    json.dump(timing, f)
+extra["start_step"] = start
+# steps a PRIOR generation already executed past the restore point are
+# REWORK, not productive: the shared step log knows the global high-water
+prev_max = -1
+try:
+    with open(os.path.join(marker_dir, "steps.log")) as f:
+        for ln in f:
+            prev_max = max(prev_max, int(ln.split()[1]))
+except (OSError, ValueError, IndexError):
+    pass
+dump_ledger()
 with open(os.path.join(marker_dir, f"pid_r{restart}"), "w") as f:
     f.write(str(os.getpid()))
 log = open(os.path.join(marker_dir, "steps.log"), "a")
@@ -380,7 +409,13 @@ while s < total_steps:
     # boundary — staging, disk saves and step reports all fire there
     # (fused=1 degenerates to the per-step loop)
     k_eff = min(fused - s % fused, total_steps - s)
-    time.sleep(dt * k_eff)  # the simulated K-step fusion
+    n_rework = max(0, min(s + k_eff, prev_max + 1) - s)
+    if n_rework:
+        with led.window("rework"):
+            time.sleep(dt * n_rework)
+    if k_eff - n_rework:
+        with led.window("productive"):
+            time.sleep(dt * (k_eff - n_rework))
     step = s + k_eff - 1
     sd = {"w": np.full((8, 8), float(step), np.float32),
           "step": np.int64(step)}
@@ -396,8 +431,10 @@ while s < total_steps:
         log.write(f"{time.time()} {s + i} {restart}\n")
     log.flush()
     ctx.report_step(step)
+    dump_ledger()  # boundary-cadence: the kill sees the latest split
     s += k_eff
 ok = ckpt.wait_latest_checkpoint(60)
+dump_ledger()
 with open(os.path.join(marker_dir, "done"), "w") as f:
     f.write(f"{ok} {step}")
 """
@@ -427,8 +464,11 @@ def preempt(total_steps: int = 600, dt: float = 0.1,
     `model=True` makes every worker generation rebuild + compile the
     REAL train step, so the report's downtime split shows what each
     restart paid: `compile_s` (re-mesh XLA cost — near zero when the
-    persistent cache serves it), `restore_s` (checkpoint load), and
-    `rework_s` (re-executed steps).  `compile_cache=False` runs the
+    persistent cache serves it), `restore_s` (checkpoint load, summed
+    over the ledger's restore tiers), and `rework_s` (re-executed
+    steps).  Every number comes from per-generation GOODPUT LEDGER
+    snapshots (telemetry/ledger.py) written at fusion boundaries — the
+    same attribution the live runtime exports — not drill-local timers.  `compile_cache=False` runs the
     cold-compile control (DWT_COMPILE_CACHE=0); `cache_dir` pins the
     cache location (fresh dir → first generation cold, restarts warm).
 
@@ -446,7 +486,7 @@ def preempt(total_steps: int = 600, dt: float = 0.1,
         extra_env["DWT_COMPILE_CACHE"] = "1" if compile_cache else "0"
         if cache_dir:
             extra_env["DWT_COMPILE_CACHE_DIR"] = cache_dir
-    t_start = time.time()
+    t_start = time.monotonic()
     cli, work, ckpt_dir, marker, job = _launch_standalone(
         "preempt", _PREEMPT_WORKER,
         [total_steps, dt, ckpt_interval, "1" if flash else "0",
@@ -460,14 +500,14 @@ def preempt(total_steps: int = 600, dt: float = 0.1,
                         for _ in range(kills))
     killed = []
     for kt in kill_times:
-        delay = t_start + kt - time.time()
+        delay = t_start + kt - time.monotonic()
         if delay > 0:
             time.sleep(delay)
         # wait out worker startup/restart: a kill scheduled before the
         # (re)launched worker wrote its pid must land, not be skipped
         pid = None
-        wait_pid = time.time() + 60.0
-        while time.time() < wait_pid and cli.poll() is None:
+        wait_pid = time.monotonic() + 60.0
+        while time.monotonic() < wait_pid and cli.poll() is None:
             pids = sorted((f for f in os.listdir(marker)
                            if f.startswith("pid_r")),
                           key=lambda s: int(s[5:]))
@@ -487,17 +527,17 @@ def preempt(total_steps: int = 600, dt: float = 0.1,
             break
         try:
             os.kill(pid, signal.SIGKILL)
-            killed.append({"t": round(time.time() - t_start, 1),
+            killed.append({"t": round(time.monotonic() - t_start, 1),
                            "pid": pid})
         except OSError:
             pass
     try:
         out, _ = cli.communicate(
-            timeout=max(5.0, t_start + timeout - time.time()))
+            timeout=max(5.0, t_start + timeout - time.monotonic()))
     except subprocess.TimeoutExpired:
         cli.kill()
         out, _ = cli.communicate()
-    wall = time.time() - t_start
+    wall = time.monotonic() - t_start
 
     executed = 0
     try:
@@ -515,29 +555,49 @@ def preempt(total_steps: int = 600, dt: float = 0.1,
         "wasted_steps": max(0, executed - total_steps),
     }
     report["completed"] = os.path.exists(os.path.join(marker, "done"))
-    # downtime decomposition (one entry per worker generation): what each
-    # restart actually paid — re-mesh compile, checkpoint restore, and
-    # re-executed work.  This is where the warm pool's win shows up as a
-    # number rather than a goodput delta.
-    timings = []
+    # downtime decomposition (one GOODPUT LEDGER snapshot per worker
+    # generation, telemetry/ledger.py): what each restart actually paid —
+    # re-mesh compile, per-tier checkpoint restore, and re-executed work
+    # — credited by the same production call sites, not drill timers.
+    ledgers = []
     for name in os.listdir(marker):
-        if not name.startswith("timing_r"):
+        if not name.startswith("ledger_r") or name.endswith(".tmp"):
             continue
         try:
             with open(os.path.join(marker, name)) as f:
-                timings.append(json.load(f))
+                ledgers.append(json.load(f))
         except (OSError, ValueError):
             pass
-    timings.sort(key=lambda t: t.get("restart", 0))
-    restarts_t = [t for t in timings if t.get("restart", 0) > 0]
+    ledgers.sort(key=lambda t: t.get("restart", 0))
+    restarts_l = [t for t in ledgers if t.get("restart", 0) > 0]
+
+    def led_s(snap, state):
+        return float(snap.get("states", {}).get(state, 0.0))
+
+    restore_states = ("restore_shm", "restore_replica", "restore_storage")
     report["downtime"] = {
-        "compile_s": round(sum(t["compile_s"] for t in restarts_t), 3),
-        "compile_s_first": (round(timings[0]["compile_s"], 3)
-                            if timings else 0.0),
-        "restore_s": round(sum(t["restore_s"] for t in restarts_t), 3),
-        "rework_s": round(max(0, executed - total_steps) * dt, 3),
-        "warm_restarts": sum(1 for t in restarts_t if t.get("cache_warm")),
-        "restarts": len(restarts_t),
+        "compile_s": round(sum(led_s(t, "compile")
+                               for t in restarts_l), 3),
+        "compile_s_first": (round(led_s(ledgers[0], "compile"), 3)
+                            if ledgers else 0.0),
+        "restore_s": round(sum(led_s(t, st) for t in restarts_l
+                               for st in restore_states), 3),
+        "rework_s": round(sum(led_s(t, "rework") for t in ledgers), 3),
+        "warm_restarts": sum(1 for t in restarts_l
+                             if t.get("cache_warm")),
+        "restarts": len(restarts_l),
+    }
+    # job-level ledger aggregate (sum of per-generation cumulative
+    # snapshots — generations are disjoint processes, so summing is exact)
+    agg: Dict[str, float] = {}
+    for t in ledgers:
+        for k, v in t.get("states", {}).items():
+            agg[k] = agg.get(k, 0.0) + float(v)
+    report["ledger"] = {
+        "states": {k: round(v, 3) for k, v in sorted(agg.items())},
+        "wall_s": round(sum(float(t.get("wall_s", 0.0))
+                            for t in ledgers), 3),
+        "generations": len(ledgers),
     }
     # goodput from STEP ACCOUNTING (useful/executed — re-executed steps
     # are the fault's waste); wall-clock goodput reported alongside (it
@@ -704,6 +764,11 @@ def ckpt_corrupt(timeout: float = 180.0) -> Dict:
     blob (falls through to storage); SIGKILL mid-persist (subprocess
     saver hard-killed between shard write and manifest publish — restore
     falls back to generation N-1 and the doctor flags the torn dir).
+
+    The drill also proves the telemetry contract: a degraded restore
+    must reconstruct as ONE trace tree (`ckpt:restore` root + per-tier
+    children) from a flight-recorder dump alone, and the goodput ledger
+    must carry nonzero `restore_replica`/`restore_storage` credits.
     """
     import shutil
 
@@ -855,8 +920,9 @@ def ckpt_corrupt(timeout: float = 180.0) -> Dict:
         # (repointed by the earlier quarantine), so the step-agnostic
         # wait_latest_checkpoint would return before the persist lands
         manifest1 = os.path.join(ckpt_dir, "checkpoint-1", "manifest.json")
-        deadline = time.time() + 60
-        while not os.path.exists(manifest1) and time.time() < deadline:
+        deadline = time.monotonic() + 60
+        while not os.path.exists(manifest1) and \
+                time.monotonic() < deadline:
             time.sleep(0.05)
         assert os.path.exists(manifest1), "step-1 persist never committed"
         shm.mark_empty()
@@ -880,6 +946,38 @@ def ckpt_corrupt(timeout: float = 180.0) -> Dict:
         if mgr is not None:
             mgr.close()
         srv.stop()
+
+    # flight recorder: every restore above recorded a `ckpt:restore`
+    # span with per-tier children (telemetry/spans.py via engine.load).
+    # Flush the ring next to the checkpoints and prove a DEGRADED
+    # restore reconstructs as one trace tree from the dump alone —
+    # root + >1 distinct tier children sharing its trace_id/span_id.
+    from .telemetry import get_ledger, get_recorder, load_flight_dumps
+
+    get_recorder().flush(ckpt_dir, "drill")
+    dumps = load_flight_dumps(ckpt_dir)
+    spans = [e["data"] for d in dumps for e in d.get("events", [])
+             if e.get("kind") == "span"]
+    roots = [s for s in spans if s.get("name") == "ckpt:restore"]
+    trace_trees = 0
+    for root in roots:
+        tiers = {s["name"] for s in spans
+                 if s.get("trace_id") == root.get("trace_id")
+                 and s.get("parent_span") == root.get("span_id")
+                 and s.get("name", "").startswith("ckpt:restore:")}
+        if len(tiers) > 1 and root.get("attrs", {}).get("fallbacks", 0):
+            trace_trees += 1
+    led_states = get_ledger().snapshot()["states"]
+    report["flight"] = {
+        "dumps": len(dumps), "restore_spans": len(roots),
+        "degraded_trace_trees": trace_trees,
+        "ledger": {k: round(led_states.get(k, 0.0), 4)
+                   for k in ("restore_shm", "restore_replica",
+                             "restore_storage")},
+    }
+    flight_ok = bool(dumps and trace_trees > 0
+                     and led_states.get("restore_replica", 0.0) > 0
+                     and led_states.get("restore_storage", 0.0) > 0)
 
     # --- 7) SIGKILL mid-persist (subprocess saver, crash between shard
     # write and manifest publish) -> restore serves generation N-1
@@ -955,7 +1053,7 @@ def ckpt_corrupt(timeout: float = 180.0) -> Dict:
     report["silent_restores"] = sum(
         1 for c in cases if not c.get("bit_identical"))
     report["ok"] = bool(all(c["ok"] for c in cases) and doctor_ok
-                        and len(cases) == 7)
+                        and flight_ok and len(cases) == 7)
     if report["ok"]:
         shutil.rmtree(work, ignore_errors=True)
     else:
@@ -972,12 +1070,18 @@ _MASTER_KILL_WORKER = r"""
 import json, os, sys, time
 
 from dlrover_wuqiong_tpu.trainer.elastic import init_elastic
+from dlrover_wuqiong_tpu.telemetry import get_ledger
 
 (_ckpt_dir, marker_dir, dataset_size, batch, minibatches, dt) = (
     sys.argv[1], sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
     int(sys.argv[5]), float(sys.argv[6]))
 ctx = init_elastic()
 restart = ctx.world.restart_count
+# the outage's cost surfaces in the GOODPUT LEDGER: master_client
+# credits `degraded` for every second a verb burned blocked on the dead
+# master, while training time through the outage stays `productive`
+led = get_ledger()
+led.start()
 with open(os.path.join(marker_dir, f"start_r{restart}"), "w") as f:
     f.write(str(os.getpid()))
 # dynamic sharding straight off the master: every fetched range and every
@@ -996,7 +1100,8 @@ while True:
               f"{task.shard.start} {task.shard.end}\n")
     log.flush()
     for i in range((task.shard.end - task.shard.start) // batch):
-        time.sleep(dt)  # one training step
+        with led.window("productive"):
+            time.sleep(dt)  # one training step
         step += 1
         # per-step heartbeat: CRITICAL during the drill — these are the
         # frames that must buffer (not block, not crash) while the master
@@ -1010,7 +1115,8 @@ while True:
     log.flush()
 stats = ctx.mc.degraded_stats()
 with open(os.path.join(marker_dir, "done"), "w") as f:
-    json.dump({"steps": step, "stats": stats}, f)
+    json.dump({"steps": step, "stats": stats,
+               "ledger": led.snapshot()}, f)
 """
 
 
@@ -1033,6 +1139,9 @@ def master_kill(dataset_size: int = 576, batch: int = 4,
       block on the dead master — heartbeats buffer in degraded mode);
     - the heartbeat buffer fully drains after reconnect, and the client
       observed the fencing-epoch bump + re-registered;
+    - the worker's GOODPUT LEDGER shows the split: `degraded` (seconds
+      burned blocked on the dead master) is nonzero AND `productive`
+      kept accruing through the outage (telemetry/ledger.py);
     - wall-clock goodput (ideal step time / span) stays over `target`.
     """
     from .common.comm import addr_connectable, find_free_port
@@ -1069,8 +1178,8 @@ def master_kill(dataset_size: int = 576, batch: int = 4,
     cli = None
     out = ""
     try:
-        deadline = time.time() + 30.0
-        while time.time() < deadline and not addr_connectable(addr):
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and not addr_connectable(addr):
             time.sleep(0.1)
         if not addr_connectable(addr):
             report.update(ok=False, error="master never came up")
@@ -1089,8 +1198,8 @@ def master_kill(dataset_size: int = 576, batch: int = 4,
         shards_log = os.path.join(marker, "shards.log")
         kill_after_fetches = 2
         kill_t = restart_t = -1.0
-        deadline = time.time() + timeout / 2
-        while time.time() < deadline and cli.poll() is None:
+        deadline = time.monotonic() + timeout / 2
+        while time.monotonic() < deadline and cli.poll() is None:
             try:
                 with open(shards_log) as f:
                     fetches = sum(1 for ln in f if ln.startswith("fetch "))
@@ -1110,9 +1219,11 @@ def master_kill(dataset_size: int = 576, batch: int = 4,
         logger.info("master-kill: SIGKILLed master pid=%d", master.pid)
         time.sleep(outage_s)
         master = spawn_master()
-        deadline = time.time() + 30.0
-        while time.time() < deadline and not addr_connectable(addr):
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and not addr_connectable(addr):
             time.sleep(0.05)
+        # kill_t/restart_t stay WALL clock: they bracket step timestamps
+        # the worker logs with time.time() in another process
         restart_t = time.time()
         report["measured_outage_s"] = round(restart_t - kill_t, 2)
 
@@ -1129,11 +1240,24 @@ def master_kill(dataset_size: int = 576, batch: int = 4,
         done_path = os.path.join(marker, "done")
         report["completed"] = os.path.exists(done_path)
         stats: Dict = {}
+        worker_ledger: Dict = {}
         if report["completed"]:
             with open(done_path) as f:
                 payload = json.load(f)
             stats = payload.get("stats", {})
             report["degraded"] = stats
+            worker_ledger = payload.get("ledger", {})
+        led_states = worker_ledger.get("states", {})
+        # the ledger is the drill's downtime split: blocked-on-dead-master
+        # seconds land in `degraded`, steps through the outage stay
+        # `productive` (master_client._account_degraded)
+        report["ledger"] = {
+            "degraded_s": round(float(led_states.get("degraded", 0.0)), 3),
+            "productive_s": round(
+                float(led_states.get("productive", 0.0)), 3),
+            "goodput_fraction": round(
+                float(worker_ledger.get("goodput_fraction", 0.0)), 4),
+        }
         fetched, completed, steps = [], [], []
         try:
             with open(shards_log) as f:
@@ -1179,6 +1303,8 @@ def master_kill(dataset_size: int = 576, batch: int = 4,
             and report["heartbeats_buffered"] > 0
             and report["buffer_drained"]
             and report["epoch_bumped"] and report["reregistered"]
+            and report["ledger"]["degraded_s"] > 0
+            and report["ledger"]["productive_s"] > 0
             and report["goodput_wall"] >= target)
         return report
     finally:

@@ -292,7 +292,7 @@ class AsyncCheckpointSaver:
     def save_step_checkpoint(self, step: int, path: str,
                              commit_timeout: Optional[float] = None):
         """Persist all local shards of `step` then commit."""
-        start = time.time()
+        start = time.monotonic()
         sdir = step_dir(path, step)
         self.storage.safe_makedirs(os.path.join(sdir,
                                                 CheckpointConstant.DONE_DIR))
@@ -315,7 +315,7 @@ class AsyncCheckpointSaver:
             # checkpoint eligible for the teardown/failure flush retry
             self._last_persisted_step = step
             self._latest_path = path
-            elapsed = time.time() - start
+            elapsed = time.monotonic() - start
             logger.info("persisted checkpoint step=%d to %s in %.2fs", step,
                         sdir, elapsed)
             try:
@@ -464,8 +464,8 @@ class AsyncCheckpointSaver:
         sdir = step_dir(path, step)
         done_dir = os.path.join(sdir, CheckpointConstant.DONE_DIR)
         expected = expected_shards or self.local_shard_num
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
             if len(self.storage.listdir(done_dir)) >= expected:
                 _maybe_crash("before-manifest")
                 # commit order: manifest (digests over everything) →

@@ -33,6 +33,8 @@ from ..common.constants import CheckpointConstant
 from ..common.log import get_logger
 from ..common.multi_process import SharedLock, SharedQueue
 from ..common.storage import CheckpointStorage, get_checkpoint_storage
+from ..telemetry import spans as tspans
+from ..telemetry.ledger import get_ledger
 from .ckpt_saver import (
     AsyncCheckpointSaver,
     CheckpointEvent,
@@ -214,38 +216,49 @@ class CheckpointEngine:
     def _start_save(self, step: int, state: Any, extra_meta: Optional[Dict],
                     path: Optional[str],
                     storage_path: Optional[str]) -> float:
-        t0 = time.time()
-        self._wait_drain()  # one staging at a time keeps the segment whole
-        extra = dict(extra_meta or {})
-        # tag the segment with its checkpoint dir so a later process can't
-        # restore a stale segment left over from an unrelated job run
-        extra.setdefault("_ckpt_dir", path or self.checkpoint_dir)
-        try:
-            snapshot = self._device_snapshot(state)
-        except Exception as e:  # noqa: BLE001
-            # state too big to double-buffer in HBM (e.g. GPT-2 xl + AdamW on
-            # a 16GB chip): fall back to synchronous staging straight from
-            # the live buffers — slower blocking save, but correct
-            from ..common.util import is_oom_error
+        with tspans.span("ckpt:save", {"step": step}):
+            t0 = time.monotonic()
+            self._wait_drain()  # one staging at a time keeps the segment whole
+            # ledger split: waiting out the PRIOR async staging is persist
+            # stall; everything after is this save's own stage cost
+            t_persist = time.monotonic() - t0
+            get_ledger().account("ckpt_persist", t_persist)
+            extra = dict(extra_meta or {})
+            # tag the segment with its checkpoint dir so a later process can't
+            # restore a stale segment left over from an unrelated job run
+            extra.setdefault("_ckpt_dir", path or self.checkpoint_dir)
+            try:
+                snapshot = self._device_snapshot(state)
+            except Exception as e:  # noqa: BLE001
+                # state too big to double-buffer in HBM (e.g. GPT-2 xl +
+                # AdamW on a 16GB chip): fall back to synchronous staging
+                # straight from the live buffers — slower blocking save,
+                # but correct
+                from ..common.util import is_oom_error
 
-            if not is_oom_error(e):
-                raise
-            logger.warning("device snapshot does not fit HBM; staging "
-                           "synchronously (%s)", type(e).__name__)
-            self._stage_locked(state, step, extra)
+                if not is_oom_error(e):
+                    raise
+                logger.warning("device snapshot does not fit HBM; staging "
+                               "synchronously (%s)", type(e).__name__)
+                self._stage_locked(state, step, extra)
+                self._latest_step = step
+                if storage_path is not None:
+                    self._event_queue.put(CheckpointEvent.save(step,
+                                                               storage_path))
+                blocked = time.monotonic() - t0
+                get_ledger().account("ckpt_stage",
+                                     max(0.0, blocked - t_persist))
+                return blocked
             self._latest_step = step
-            if storage_path is not None:
-                self._event_queue.put(CheckpointEvent.save(step,
-                                                           storage_path))
-            return time.time() - t0
-        self._latest_step = step
-        self._drain_thread = threading.Thread(
-            target=self._drain, args=(snapshot, step, extra, storage_path),
-            daemon=True, name="dwt-ckpt-drain")
-        self._drain_thread.start()
-        blocked = time.time() - t0
-        self._record_blocking_metric(blocked)
-        return blocked
+            self._drain_thread = threading.Thread(
+                target=self._drain, args=(snapshot, step, extra,
+                                          storage_path),
+                daemon=True, name="dwt-ckpt-drain")
+            self._drain_thread.start()
+            blocked = time.monotonic() - t0
+            get_ledger().account("ckpt_stage", max(0.0, blocked - t_persist))
+            self._record_blocking_metric(blocked)
+            return blocked
 
     def _report_ckpt_health(self, tier: str, reason: str):
         """Checkpoint-health event: local metric + master node event.
@@ -313,14 +326,14 @@ class CheckpointEngine:
 
         Keeps the bool contract: staging timeouts/errors → False, not raise.
         """
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout
         try:
             self._wait_drain(timeout)
         except (TimeoutError, Exception):  # noqa: BLE001
             logger.warning("staging did not complete within %ss", timeout,
                            exc_info=True)
             return False
-        while time.time() < deadline:
+        while time.monotonic() < deadline:
             if read_last_step(self.checkpoint_dir,
                               self.storage) >= self._latest_step:
                 return True
@@ -345,7 +358,23 @@ class CheckpointEngine:
         ``self.last_restore`` reports which tier/generation served and
         every fallback taken.  Names containing ``#shardN`` are assembled
         into full global arrays.
+
+        Telemetry: the walk opens a ``ckpt:restore`` span with one child
+        per tier attempted, and each tier's wall time is credited to its
+        own ledger state (restore_shm / restore_replica / restore_storage)
+        — a degraded restore shows exactly where the time went.
         """
+        with tspans.span("ckpt:restore",
+                         {"step": -1 if step is None else step}) as rec:
+            result = self._load_tiered(path, step)
+            rec["attrs"]["tier"] = self.last_restore.get("tier", "none")
+            rec["attrs"]["fallbacks"] = len(
+                self.last_restore.get("fallbacks", []))
+            return result
+
+    def _load_tiered(self, path: Optional[str],
+                     step: Optional[int]) -> Optional[Dict[str, np.ndarray]]:
+        led = get_ledger()
         self._wait_drain()  # an in-flight staging must land before reading
         path = path or self.checkpoint_dir
         report: Dict = {"tier": "none", "step": -1, "fallbacks": [],
@@ -354,7 +383,8 @@ class CheckpointEngine:
 
         stale_shm = None  # verified shm OLDER than the storage tracker:
         # kept as a candidate in case the newer storage gens are corrupt
-        flat, shm_step, reason = self._load_verified_shm(path, step)
+        with tspans.span("ckpt:restore:shm"), led.window("restore_shm"):
+            flat, shm_step, reason = self._load_verified_shm(path, step)
         if flat is not None:
             if step is not None or shm_step >= read_last_step(
                     path, self.storage):
@@ -371,13 +401,19 @@ class CheckpointEngine:
         # (replica.py digest-checks the blob before it touches the
         # segment), then re-verify end to end
         if stale_shm is None and self.replica_fetch is not None:
-            try:
-                fetched = self.replica_fetch()
-            except Exception:  # noqa: BLE001 — replica tier is best-effort
-                logger.exception("replica fetch failed")
-                fetched = None
+            with tspans.span("ckpt:restore:replica"), \
+                    led.window("restore_replica"):
+                try:
+                    fetched = self.replica_fetch()
+                except Exception:  # noqa: BLE001 — replica is best-effort
+                    logger.exception("replica fetch failed")
+                    fetched = None
+                if fetched is not None:
+                    flat, shm_step, reason = self._load_verified_shm(
+                        path, step)
+                else:
+                    flat, shm_step, reason = None, -1, None
             if fetched is not None:
-                flat, shm_step, reason = self._load_verified_shm(path, step)
                 if flat is not None and (
                         step is not None or shm_step >= read_last_step(
                             path, self.storage)):
@@ -394,7 +430,9 @@ class CheckpointEngine:
                     if _is_corruption(reason):
                         self._report_ckpt_health("replica", reason)
 
-        flat = self.load_from_storage(path, step, _report=report)
+        with tspans.span("ckpt:restore:storage"), \
+                led.window("restore_storage"):
+            flat = self.load_from_storage(path, step, _report=report)
         if flat is not None:
             if stale_shm is not None and stale_shm[0] > report["step"]:
                 # every storage gen newer than the stale shm was corrupt:

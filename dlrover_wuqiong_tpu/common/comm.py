@@ -20,9 +20,17 @@ Master fault tolerance rides in the envelope:
   ``MasterUnreachableError`` so callers can tell "master answered with an
   error" (RpcError — never retried) from "master is gone" (degraded mode).
 
+Distributed tracing rides the same envelope (telemetry/spans.py): a
+client call opens an ``rpc:<verb>`` span and stamps its context into the
+optional ``trace`` field; the servicer side adopts it and opens
+``serve:<verb>`` under the caller's span, so one restore or re-mesh
+reconstructs as a single trace tree across agent/master/saver processes.
+Untraced peers (fakes, old frames) simply omit the field.
+
 Wire format per frame: 4-byte big-endian length + JSON body
   request:  {"verb": "get"|"report", "node_id": int, "node_type": str,
-             "payload": <encoded message>, "idem": str?}
+             "payload": <encoded message>, "idem": str?,
+             "trace": {"trace_id": str, "span_id": str}?}
   response: {"ok": bool, "error": str, "payload": <encoded message|null>,
              "epoch": int|null}
 """
@@ -36,6 +44,7 @@ import struct
 import threading
 from typing import Any, Callable, Optional
 
+from ..telemetry import spans as tspans
 from . import serialize
 from .log import get_logger
 from .util import retry_call
@@ -136,11 +145,19 @@ class RpcServer:
                                 req.get("node_id", -1),
                                 req.get("node_type", ""),
                                 req.get("payload"))
-                        if outer._pass_idem:
-                            resp = outer._handler(*args,
-                                                  idem=req.get("idem"))
-                        else:
-                            resp = outer._handler(*args)
+                        payload_name = type(req.get("payload")).__name__
+                        # adopt the caller's trace so serve:<verb> nests
+                        # under the client's rpc:<verb> span
+                        with tspans.extract(req.get("trace")), \
+                                tspans.span(
+                                    f"serve:{req.get('verb', 'get')}",
+                                    {"node_id": req.get("node_id", -1),
+                                     "msg": payload_name}):
+                            if outer._pass_idem:
+                                resp = outer._handler(
+                                    *args, idem=req.get("idem"))
+                            else:
+                                resp = outer._handler(*args)
                         body = serialize.dumps(
                             {"ok": True, "error": "", "payload": resp,
                              "epoch": epoch}
@@ -251,24 +268,30 @@ class RpcClient:
     def _call(self, verb: str, payload: Any, idem: Optional[str] = None,
               attempts: Optional[int] = None,
               deadline_s: Optional[float] = None) -> Any:
-        envelope = {"verb": verb, "node_id": self._node_id,
-                    "node_type": self._node_type, "payload": payload}
-        if idem is not None:
-            envelope["idem"] = idem
-        req = serialize.dumps(envelope)
-        if attempts is None and deadline_s is None:
-            attempts = self._retries
-        try:
-            resp = retry_call(
-                lambda: self._attempt(req),
-                attempts=attempts, deadline_s=deadline_s,
-                base_delay_s=self._base_delay_s,
-                max_delay_s=self._max_delay_s,
-                retry_on=TRANSPORT_ERRORS)
-        except TRANSPORT_ERRORS as e:
-            raise MasterUnreachableError(
-                f"rpc {verb} to {self._addr} failed after retries: "
-                f"{type(e).__name__}: {e}") from e
+        with tspans.span(f"rpc:{verb}",
+                         {"msg": type(payload).__name__,
+                          "node_id": self._node_id}):
+            envelope = {"verb": verb, "node_id": self._node_id,
+                        "node_type": self._node_type, "payload": payload}
+            trace = tspans.inject()
+            if trace is not None:
+                envelope["trace"] = trace
+            if idem is not None:
+                envelope["idem"] = idem
+            req = serialize.dumps(envelope)
+            if attempts is None and deadline_s is None:
+                attempts = self._retries
+            try:
+                resp = retry_call(
+                    lambda: self._attempt(req),
+                    attempts=attempts, deadline_s=deadline_s,
+                    base_delay_s=self._base_delay_s,
+                    max_delay_s=self._max_delay_s,
+                    retry_on=TRANSPORT_ERRORS, label=verb)
+            except TRANSPORT_ERRORS as e:
+                raise MasterUnreachableError(
+                    f"rpc {verb} to {self._addr} failed after retries: "
+                    f"{type(e).__name__}: {e}") from e
         self._observe_epoch(resp.get("epoch"))
         if not resp.get("ok"):
             raise RpcError(resp.get("error", "unknown rpc error"))

@@ -230,6 +230,39 @@ class CustomMetric:
     data: Dict[str, float] = field(default_factory=dict)
 
 
+@message
+class GoodputLedgerReport:
+    """Cumulative per-node goodput ledger snapshot (telemetry/ledger.py).
+
+    Totals are cumulative since trainer start, so the report is drop- and
+    replay-safe over the BUFFERED verb class: the master keeps the latest
+    snapshot per node and sums across nodes.  ``states`` keys come from
+    ``LEDGER_STATES`` (add-only schema).
+    """
+
+    node_id: int = -1
+    wall_s: float = 0.0
+    states: Dict[str, float] = field(default_factory=dict)
+    other_s: float = 0.0
+    goodput_fraction: float = 0.0
+
+
+@message
+class GoodputQuery:
+    """Pull the job-level ledger aggregation (tools/goodput_report.py)."""
+
+    pass
+
+
+@message
+class GoodputSummary:
+    states: Dict[str, float] = field(default_factory=dict)
+    wall_s: float = 0.0
+    other_s: float = 0.0
+    goodput_fraction: float = 0.0
+    nodes: int = 0
+
+
 # ---------------------------------------------------------------- kv store
 
 

@@ -229,14 +229,14 @@ class SharedLock(LocalSocketComm):
             if not request.get("blocking", True):
                 return {"ok": self._try_acquire(pid)}
             timeout = request.get("timeout", -1)
-            deadline = (time.time() + timeout) if timeout and timeout > 0 \
+            deadline = (time.monotonic() + timeout) if timeout and timeout > 0 \
                 else None
             # poll instead of a blocking Lock.acquire so a holder that
             # dies WHILE we wait is noticed within one poll interval
             while True:
                 if self._try_acquire(pid):
                     return {"ok": True}
-                if deadline is not None and time.time() >= deadline:
+                if deadline is not None and time.monotonic() >= deadline:
                     return {"ok": False}
                 time.sleep(0.05)
         if op == "release":

@@ -26,6 +26,7 @@ def retry_call(fn: Callable[[], Any], *,
                jitter: float = 0.25,
                retry_on: Tuple[Type[BaseException], ...] = (Exception,),
                on_retry: Optional[Callable] = None,
+               label: Optional[str] = None,
                sleep: Callable[[float], None] = time.sleep) -> Any:
     """THE retry policy of this repo: bounded exponential backoff + jitter.
 
@@ -50,7 +51,25 @@ def retry_call(fn: Callable[[], Any], *,
     to the remaining deadline.  `on_retry(n_retries, exc, delay_s)` fires
     before each sleep — callers use it for logging and for tearing down
     poisoned state (bench.py drops the dead backend client there).
+
+    `label` (e.g. the rpc verb) opens a ``retry:<label>`` trace span
+    covering the whole bounded loop, with the retry count in its attrs
+    (telemetry/spans.py) — per-RPC attribution without a second timing
+    path.  None (the default) keeps the call untraced and zero-cost.
     """
+    if label is not None:
+        from ..telemetry import spans as _spans
+
+        with _spans.span(f"retry:{label}") as rec:
+            return _retry_loop(fn, attempts, deadline_s, base_delay_s,
+                               max_delay_s, jitter, retry_on, on_retry,
+                               sleep, rec)
+    return _retry_loop(fn, attempts, deadline_s, base_delay_s, max_delay_s,
+                       jitter, retry_on, on_retry, sleep, None)
+
+
+def _retry_loop(fn, attempts, deadline_s, base_delay_s, max_delay_s,
+                jitter, retry_on, on_retry, sleep, span_rec) -> Any:
     if attempts is None and deadline_s is None:
         attempts = 3  # both unbounded would spin forever on a hard fault
     start = time.monotonic()
@@ -70,6 +89,8 @@ def retry_call(fn: Callable[[], Any], *,
                     raise
                 delay = min(delay, remaining)
             i += 1
+            if span_rec is not None:
+                span_rec["attrs"]["retries"] = i
             if on_retry is not None:
                 on_retry(i, e, delay)
             if delay > 0:

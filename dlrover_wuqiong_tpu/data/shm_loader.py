@@ -150,8 +150,8 @@ class ShmCoworkerLoader:
         self._recycle()
         if self._max_steps >= 0 and self._yielded >= self._max_steps:
             raise StopIteration
-        deadline = time.time() + 300
-        while time.time() < deadline:
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
             if self._max_steps >= 0 and self._yielded >= self._max_steps:
                 raise StopIteration
             try:

@@ -155,7 +155,7 @@ class DiagnosisDataManager:
         profile window must not be cited for a hang hours later."""
         with self._lock:
             ts, content = self._op_profiles.get(node_id, (0.0, ""))
-            if content and time.time() - ts > max_age:
+            if content and time.time() - ts > max_age:  # graftlint: disable=wall-clock-duration -- ts is a node-reported wall timestamp (cross-process)
                 return ""
             return content
 
@@ -190,7 +190,7 @@ class CheckTrainingHangOperator(InferenceOperator):
         latest = data.latest_step_time()
         if latest is None:
             return []
-        if time.time() - latest > self.timeout:
+        if time.time() - latest > self.timeout:  # graftlint: disable=wall-clock-duration -- step-report timestamps are node wall clock (cross-process)
             return [Inference("training_hang",
                               detail=f"no step progress for "
                                      f">{self.timeout:.0f}s")]

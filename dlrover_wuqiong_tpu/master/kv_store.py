@@ -37,10 +37,10 @@ class KVStoreService:
             return cur
 
     def wait(self, keys: List[str], timeout: float = 300.0) -> bool:
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout
         with self._cond:
             while not all(k in self._store for k in keys):
-                remaining = deadline - time.time()
+                remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return False
                 self._cond.wait(remaining)

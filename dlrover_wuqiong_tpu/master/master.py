@@ -89,6 +89,7 @@ class JobMaster:
             ctx.hang_detection_seconds, job_manager=self.job_manager)
         self._custom_metrics: Dict = {}
         self._node_events: list = []
+        self._goodput: Dict[int, msg.GoodputLedgerReport] = {}
         self._paral_config = msg.ParallelConfig()
         # ------------------------------------------------- fault tolerance
         # journal + fencing epoch (master/journal.py): with a journal dir,
@@ -308,6 +309,47 @@ class JobMaster:
         self._node_events.append(event)
         if len(self._node_events) > 1000:
             self._node_events = self._node_events[-500:]
+        # node events are flight-recorder events on the master too — a
+        # master-side dump carries the fault context workers reported
+        from ..telemetry.recorder import get_recorder
+
+        get_recorder().record("node_event", event.event_type, {
+            "node_id": event.node_id, "reason": event.reason,
+            "message": event.message, "level": event.level})
+
+    # ------------------------------------------------------------- goodput
+
+    def collect_goodput(self, report: msg.GoodputLedgerReport):
+        """Latest-wins per-node ledger snapshot (reports are cumulative,
+        so drops/replays over the BUFFERED verb class are harmless)."""
+        self._goodput[report.node_id] = report
+        for state, secs in report.states.items():
+            self.metric_collector.reg.gauge(
+                "dwt_goodput_seconds", float(secs),
+                {"job": self.metric_collector.job, "state": str(state),
+                 "node": str(report.node_id)},
+                help="cumulative trainer wall seconds per ledger state")
+        self.metric_collector.reg.gauge(
+            "dwt_goodput_fraction", report.goodput_fraction,
+            {"job": self.metric_collector.job,
+             "node": str(report.node_id)},
+            help="productive fraction of trainer wall time")
+
+    def goodput_summary(self) -> msg.GoodputSummary:
+        """Job-level aggregation: sum the latest per-node snapshots."""
+        states: Dict[str, float] = {}
+        wall = other = 0.0
+        for rep in self._goodput.values():
+            wall += rep.wall_s
+            other += rep.other_s
+            for state, secs in rep.states.items():
+                states[state] = states.get(state, 0.0) + float(secs)
+        productive = states.get("productive", 0.0)
+        total = max(wall, sum(states.values()))
+        return msg.GoodputSummary(
+            states=states, wall_s=wall, other_s=other,
+            goodput_fraction=(productive / total) if total > 0 else 0.0,
+            nodes=len(self._goodput))
 
     # --------------------------------------------------------------- run loop
 
@@ -319,14 +361,14 @@ class JobMaster:
         all_workers_exited, task_hanged → exit code).
         """
         ctx = get_context()
-        start = time.time()
+        start = time.monotonic()
         while not self._stopped.wait(poll_interval):
             self._collect_metrics()
             if self.journal is not None and \
                     self.journal.entries_since_snapshot >= \
                     self.journal.snapshot_every:
                 self.snapshot_journal()
-            if max_seconds and time.time() - start > max_seconds:
+            if max_seconds and time.monotonic() - start > max_seconds:
                 self._exit_reason = JobExitReason.UNCOMPLETED_TIMEOUT
                 self._exit_code = 1
                 break

@@ -30,11 +30,23 @@ def _labels_key(labels: Optional[Dict[str, str]]) -> _LabelKey:
     return tuple(sorted((labels or {}).items()))
 
 
+def _escape_label_value(v: str) -> str:
+    """Exposition-format label escaping: backslash, quote, newline."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _fmt_labels(key: _LabelKey) -> str:
     if not key:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in key)
     return "{" + inner + "}"
+
+
+#: default histogram bucket upper bounds (seconds-oriented, exponential);
+#: rendered cumulatively with a trailing +Inf per the exposition format
+DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
+                   30.0, 60.0, 300.0, 600.0)
 
 
 class MetricRegistry:
@@ -45,6 +57,7 @@ class MetricRegistry:
         self._gauges: Dict[str, Dict[_LabelKey, float]] = {}
         self._counters: Dict[str, Dict[_LabelKey, float]] = {}
         self._hists: Dict[str, Dict[_LabelKey, List[float]]] = {}
+        self._buckets: Dict[str, Tuple[float, ...]] = {}
         self._help: Dict[str, str] = {}
 
     def gauge(self, name: str, value: float,
@@ -65,7 +78,8 @@ class MetricRegistry:
 
     def observe(self, name: str, value: float,
                 labels: Optional[Dict[str, str]] = None, help: str = "",
-                max_samples: int = 1000):
+                max_samples: int = 1000,
+                buckets: Optional[Tuple[float, ...]] = None):
         with self._lock:
             d = self._hists.setdefault(name, {})
             k = _labels_key(labels)
@@ -73,6 +87,8 @@ class MetricRegistry:
             samples.append(value)
             if len(samples) > max_samples:
                 del samples[:len(samples) - max_samples]
+            if buckets is not None:
+                self._buckets[name] = tuple(sorted(buckets))
             if help:
                 self._help[name] = help
 
@@ -113,16 +129,27 @@ class MetricRegistry:
             for name, series in sorted(self._hists.items()):
                 if name in self._help:
                     out.append(f"# HELP {name} {self._help[name]}")
-                out.append(f"# TYPE {name} summary")
+                out.append(f"# TYPE {name} histogram")
+                bounds = self._buckets.get(name, DEFAULT_BUCKETS)
                 for k, samples in series.items():
                     if not samples:
                         continue
                     s = sorted(samples)
-                    for q in (0.5, 0.9, 0.99):
-                        idx = min(len(s) - 1, int(q * len(s)))
-                        qk = k + (("quantile", str(q)),)
-                        out.append(f"{name}{_fmt_labels(tuple(sorted(qk)))}"
-                                   f" {s[idx]}")
+                    # cumulative bucket counts, non-decreasing by
+                    # construction, closed by the mandatory +Inf bucket
+                    cum = 0
+                    i = 0
+                    for le in bounds:
+                        while i < len(s) and s[i] <= le:
+                            i += 1
+                        cum = i
+                        bk = k + (("le", repr(float(le))),)
+                        out.append(
+                            f"{name}_bucket"
+                            f"{_fmt_labels(tuple(sorted(bk)))} {cum}")
+                    bk = k + (("le", "+Inf"),)
+                    out.append(f"{name}_bucket"
+                               f"{_fmt_labels(tuple(sorted(bk)))} {len(s)}")
                     out.append(f"{name}_count{_fmt_labels(k)} {len(s)}")
                     out.append(f"{name}_sum{_fmt_labels(k)} {sum(s)}")
         return "\n".join(out) + "\n"

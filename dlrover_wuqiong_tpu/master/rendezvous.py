@@ -108,7 +108,8 @@ class RendezvousManager(ABC):
                     node_id, node_rank, local_world_size, node_ip,
                     free_port, slice_id)
                 if not self._start_rdzv_ts:
-                    self._start_rdzv_ts = time.time()
+                    # monotonic: elapsed-wait math only, never persisted
+                    self._start_rdzv_ts = time.monotonic()
                 logger.info(
                     "%s: node %s (rank hint %s) joined; waiting=%d round=%d",
                     self.name, node_id, node_rank, len(self._waiting_nodes),
@@ -157,7 +158,8 @@ class RendezvousManager(ABC):
                         return True
                 except Exception:  # noqa: BLE001 — policy is advisory
                     logger.debug("warm-mesh policy failed", exc_info=True)
-        return (time.time() - self._start_rdzv_ts) > self._params.waiting_timeout
+        return (time.monotonic()
+                - self._start_rdzv_ts) > self._params.waiting_timeout
 
     def _form_world(self):
         # topology-aware ordering: same-slice/subnet nodes get contiguous
@@ -183,10 +185,18 @@ class RendezvousManager(ABC):
         for spec in specs:
             del self._waiting_nodes[spec.node_id]
         self._latest_rdzv_nodes = [s.node_id for s in specs]
+        wait_s = (time.monotonic() - self._start_rdzv_ts
+                  if self._start_rdzv_ts else 0.0)
         self._start_rdzv_ts = 0.0
         self._rdzv_round += 1
         logger.info("%s: formed world round=%d nodes=%s", self.name,
                     self._rdzv_round, self._latest_rdzv_nodes)
+        from ..telemetry import spans as tspans
+
+        tspans.span_event(f"rdzv:{self.name}:world-formed",
+                          {"round": self._rdzv_round,
+                           "nodes": len(self._latest_rdzv_nodes),
+                           "wait_s": wait_s})
         if self.on_world_formed is not None:
             try:
                 # _form_world runs under self._lock — use the lock-free view
@@ -259,7 +269,7 @@ class RendezvousManager(ABC):
         with self._lock:
             return bool(
                 self._start_rdzv_ts
-                and time.time() - self._start_rdzv_ts
+                and time.monotonic() - self._start_rdzv_ts
                 > self._params.join_timeout)
 
 

@@ -97,7 +97,7 @@ class PodScaler(Scaler):
                              spec.node_type, spec.node_id, attempt + 1)
                 return
             self._ensure_retry_thread()
-            self._retry_q.put((time.time() + self._retry_interval, spec,
+            self._retry_q.put((time.monotonic() + self._retry_interval, spec,
                                attempt + 1))
 
     def _delete(self, node: Node):
@@ -120,7 +120,7 @@ class PodScaler(Scaler):
                 due, spec, attempt = self._retry_q.get(timeout=1.0)
             except queue.Empty:
                 continue
-            delay = due - time.time()
+            delay = due - time.monotonic()
             if delay > 0:
                 if self._stopped.wait(delay):
                     return

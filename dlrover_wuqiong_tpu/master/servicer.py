@@ -154,6 +154,9 @@ class MasterServicer:
         if isinstance(payload, msg.ParallelConfigRequest):
             return m.get_paral_config(payload.node_id)
 
+        if isinstance(payload, msg.GoodputQuery):
+            return m.goodput_summary()
+
         raise ValueError(f"unknown get message: {type(payload).__name__}")
 
     def _report(self, node_id: int, node_type: str, payload: Any,
@@ -306,6 +309,12 @@ class MasterServicer:
 
         if isinstance(payload, (msg.ModelInfo, msg.CustomMetric)):
             m.collect_custom_data(payload)
+            return msg.OkResponse()
+
+        if isinstance(payload, msg.GoodputLedgerReport):
+            # pure telemetry (cumulative snapshot, latest-wins) — no
+            # journal frame; a master restart just waits for the next one
+            m.collect_goodput(payload)
             return msg.OkResponse()
 
         if isinstance(payload, msg.DiagnosisReport):

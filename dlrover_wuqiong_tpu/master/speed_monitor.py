@@ -23,9 +23,22 @@ class SpeedMonitor:
         self._workers: Set[int] = set()
         self._init_time = time.time()
         self._max_speed = 0.0
+        # reading before the first set_target_worker_num used to raise
+        # AttributeError (never initialized here) — default to 0
+        self._target_worker_num = 0
 
     def set_target_worker_num(self, num: int):
         self._target_worker_num = num
+
+    @property
+    def target_worker_num(self) -> int:
+        return self._target_worker_num
+
+    def all_worker_joined(self) -> bool:
+        """True when every expected worker is running (0 target = never)."""
+        with self._lock:
+            return (self._target_worker_num > 0 and
+                    len(self._workers) >= self._target_worker_num)
 
     def add_running_worker(self, node_id: int):
         with self._lock:
@@ -86,7 +99,7 @@ class SpeedMonitor:
         with self._lock:
             if self._start_training_time is None or self._max_speed <= 0:
                 return 0.0
-            elapsed = time.time() - self._start_training_time
+            elapsed = time.time() - self._start_training_time  # graftlint: disable=wall-clock-duration -- step records carry node-reported wall timestamps (cross-process)
             if elapsed <= 0:
                 return 0.0
             # steps completed / (elapsed * peak speed)

@@ -91,7 +91,7 @@ class RayJobSubmitter:
     def wait(self, timeout: float = 0.0, stream_logs: bool = True) -> str:
         """Poll until a terminal status; returns it.  timeout 0 = forever."""
         poll = float(self.conf.get("pollInterval", 5.0))
-        deadline = time.time() + timeout if timeout else None
+        deadline = time.monotonic() + timeout if timeout else None
         printed = 0
         while True:
             status = self.status()
@@ -111,7 +111,7 @@ class RayJobSubmitter:
             if status in TERMINAL_STATUSES:
                 logger.info("ray job %s finished: %s", self.job_id, status)
                 return status
-            if deadline and time.time() > deadline:
+            if deadline and time.monotonic() > deadline:
                 raise TimeoutError(
                     f"ray job {self.job_id} still {status} after "
                     f"{timeout}s")

@@ -109,8 +109,8 @@ class RaySchedulerClient(SchedulerClient):
             return list(self._nodes.values())
 
     def watch(self, timeout: float = 1.0) -> Iterator[NodeEvent]:
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
             got = False
             try:
                 while True:
@@ -124,7 +124,7 @@ class RaySchedulerClient(SchedulerClient):
                         yield self._events.get_nowait()
                 except queue.Empty:
                     pass
-                deadline = time.time() + timeout
+                deadline = time.monotonic() + timeout
             else:
                 time.sleep(0.05)
 

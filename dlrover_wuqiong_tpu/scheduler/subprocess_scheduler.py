@@ -108,8 +108,8 @@ class SubprocessSchedulerClient(SchedulerClient):
 
     def watch(self, timeout: float = 1.0) -> Iterator[NodeEvent]:
         """Launch events + process-exit polling."""
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
             got = False
             try:
                 while True:
@@ -121,7 +121,7 @@ class SubprocessSchedulerClient(SchedulerClient):
             for e in events:
                 yield e
             if events or got:
-                deadline = time.time() + timeout
+                deadline = time.monotonic() + timeout
             else:
                 time.sleep(0.05)
 

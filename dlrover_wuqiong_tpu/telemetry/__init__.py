@@ -1,0 +1,50 @@
+"""Unified runtime telemetry: goodput ledger, trace spans, flight recorder.
+
+Parity: reference `dlrover/python/master/monitor/speed_monitor.py` (the
+master's only live training signal) + the xpu_timer always-on timing
+intent (`atorch/dev/xpu_timer/common/manager.cc` — runtime metrics
+exported continuously, not just inside benchmarks).
+
+TPU redesign: the reference stack measures speed from reported steps and
+leaves downtime attribution to offline log spelunking.  Here every second
+of trainer wall time lands in exactly one ledger state (telemetry/
+ledger.py), control-plane and checkpoint work is traced with
+cross-process spans riding the typed JSON frames (telemetry/spans.py),
+and each process keeps a bounded flight-recorder ring flushed to
+``$ckpt_dir/flight/`` on faults (telemetry/recorder.py) — the measurement
+substrate the Brain's adaptive policies read from instead of chaos-drill
+ad-hoc timers.
+
+Schemas are ADD-ONLY: ``LEDGER_STATES``, the ledger snapshot keys and the
+flight-dump envelope keys are pinned by tests/test_telemetry.py — extend,
+never rename.
+"""
+
+from .ledger import (  # noqa: F401
+    LEDGER_SCHEMA_VERSION,
+    LEDGER_STATES,
+    GoodputLedger,
+    get_ledger,
+    reset_ledger,
+)
+from .recorder import (  # noqa: F401
+    FLIGHT_SCHEMA_VERSION,
+    FlightRecorder,
+    flight_dir,
+    get_recorder,
+    load_flight_dumps,
+    reset_recorder,
+)
+from .spans import (  # noqa: F401
+    SPAN_SCHEMA_VERSION,
+    clear_spans,
+    current_trace,
+    dump_chrome_trace,
+    env_context,
+    extract,
+    inject,
+    set_process_role,
+    span,
+    span_event,
+    spans_snapshot,
+)

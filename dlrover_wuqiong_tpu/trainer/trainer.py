@@ -292,6 +292,16 @@ class Trainer:
             return a.fused_steps
         return None  # auto
 
+    def _dispatch_overhead_s(self) -> float:
+        """Per-dispatch overhead estimate for the ledger's
+        dispatch_overhead state — the cached backend probe (or the
+        DWT_DISPATCH_OVERHEAD_S pin), never a readback on step outputs."""
+        if not hasattr(self, "_disp_overhead"):
+            from ..common.util import measure_dispatch_overhead_s
+
+            self._disp_overhead = measure_dispatch_overhead_s()
+        return self._disp_overhead
+
     def _autotune_fused_k(self, step_time_s: float) -> int:
         from .train_step import auto_fused_steps
 
@@ -308,8 +318,16 @@ class Trainer:
 
         import jax
 
+        from ..telemetry.ledger import get_ledger
+        from ..telemetry.recorder import get_recorder
+
         a = self.args
+        led = get_ledger()
+        led.start()
         start_step = 0
+        # rollback rework ceiling: steps below this were trained before a
+        # loss-spike rollback and are re-executed ("rework", not goodput)
+        self._rework_until = -1
         if a.resume:
             from ..common.constants import NodeEnv
 
@@ -326,6 +344,8 @@ class Trainer:
                 self.state = restored
                 start_step = int(np.asarray(
                     jax.tree.leaves(self.state.step)[0]))
+                if rb >= 0:
+                    self._rework_until = rb
                 rep = self.ckpt.last_restore_report
                 logger.info("resumed from step %d (tier=%s%s)", start_step,
                             rep.get("tier", "?"),
@@ -343,7 +363,7 @@ class Trainer:
 
         last_loss = float("nan")
         metrics = None
-        t_log = time.time()
+        t_log = time.monotonic()
         steps_since_log = 0
         self._preempted = False
         prev_sigterm = None
@@ -357,8 +377,16 @@ class Trainer:
         stager = None
         step_time_s = 0.0
         step = start_step
+        # goodput ledger: the trainer owns productive / dispatch_overhead /
+        # data_stall / compile / rework; the checkpoint engine credits
+        # ckpt_stage/persist + restore tiers; master_client credits
+        # degraded.  All accounting happens HERE at fusion boundaries from
+        # host-side timers — never inside the jitted step, never via an
+        # extra device readback.
+        self._compiled_modes: set = set()
         try:
             while step < a.max_steps and not self._preempted:
+                t_iter0 = time.monotonic()
                 if fused_k is None and step - start_step >= 2:
                     # two unfused steps measured (the first compiles):
                     # decide K, then fuse the rest of the run
@@ -372,18 +400,21 @@ class Trainer:
                         self.res.place_fused_batch, fused_k,
                         step, a.max_steps,
                         place_single=self.res.place_batch))
-                if stager is not None:
-                    s0, k_eff, batch = next(stager)
-                else:
-                    s0, k_eff = step, 1
-                    batch = self.res.place_batch(
-                        dict(self._batch_at(self.train_data, step)))
+                with led.window("data_stall"):
+                    if stager is not None:
+                        s0, k_eff, batch = next(stager)
+                    else:
+                        s0, k_eff = step, 1
+                        batch = self.res.place_batch(
+                            dict(self._batch_at(self.train_data, step)))
+                data_s = time.monotonic() - t_iter0
                 if self._tune_listener is not None and \
                         s0 % a.tune_config_steps == 0:
                     tuned = self._tune_listener.poll()
                     if tuned:
                         self._apply_tuned_config(tuned)
                 prof_before = self.profiler.last_profile
+                t_blk0 = time.monotonic()
                 with self.profiler.step(s0):
                     if k_eff > 1:
                         self.state, metrics = self.res.fused_train_step(
@@ -397,20 +428,31 @@ class Trainer:
                             # the real step, not the async dispatch
                             float(metrics["loss"])
                             step_time_s = time.perf_counter() - t0
+                blk_s = time.monotonic() - t_blk0
+                if k_eff not in self._compiled_modes:
+                    # first dispatch at this fusion width traces+compiles
+                    self._compiled_modes.add(k_eff)
+                    led.account("compile", blk_s)
+                    credited_blk = blk_s
+                else:
+                    credited_blk = min(blk_s, self._dispatch_overhead_s())
+                    led.account("dispatch_overhead", credited_blk)
                 if self.profiler.last_profile is not prof_before:
                     # a trace window just closed: surface slow collectives
                     self.ctx.report_op_profile(
                         self.profiler.last_profile.collective_evidence())
                 step = s0 + k_eff
                 steps_since_log += k_eff
+                hooks_excl_s = 0.0  # save/eval time: credited elsewhere
+                # (engine ledger states) or left to the other_s residual
                 # ---- boundary hooks: K divides every active cadence, so
                 # these fire exactly as in the unfused loop ----
                 if a.logging_steps and step % a.logging_steps == 0:
                     # ONE host readback per fusion syncs the whole block
                     # (metrics["loss"] is the block's last step)
                     last_loss = float(metrics["loss"])
-                    dt = time.time() - t_log
-                    t_log = time.time()
+                    dt = time.monotonic() - t_log
+                    t_log = time.monotonic()
                     # re-read the live batch size: the master may retune it
                     tokens_per_step = a.seq_len * getattr(
                         self.train_data, "batch_size", a.global_batch_size)
@@ -420,12 +462,19 @@ class Trainer:
                                 last_loss, tps)
                     self.ctx.report_step(step)
                     self.ctx.report_loss(step, last_loss)
+                    if self.ctx.mc is not None:
+                        try:  # buffered verb; telemetry never kills the run
+                            self.ctx.mc.report_goodput_ledger(led.snapshot())
+                        except Exception:  # noqa: BLE001
+                            pass
                     for cb in self.callbacks:
                         cb(step, {"loss": last_loss,
                                   "tokens_per_sec": tps})
                 saved = False
                 if a.save_steps and step % a.save_steps == 0:
+                    t_h = time.monotonic()
                     self._save(step)
+                    hooks_excl_s += time.monotonic() - t_h
                     saved = True
                 if a.flash_stage_steps and not saved and \
                         step % a.flash_stage_steps == 0:
@@ -434,16 +483,41 @@ class Trainer:
                     # fusion never completes
                     from ..checkpoint.checkpointer import StorageType
 
+                    t_h = time.monotonic()
                     self.ckpt.save_checkpoint(
                         step, self.state, storage_type=StorageType.MEMORY)
+                    hooks_excl_s += time.monotonic() - t_h
                 if a.eval_steps and self.eval_data is not None and \
                         step % a.eval_steps == 0:
+                    t_h = time.monotonic()
                     eval_loss = self.evaluate()
+                    hooks_excl_s += time.monotonic() - t_h
                     logger.info("step %d eval_loss=%.4f", step, eval_loss)
+                # remainder of the iteration is the fused window itself:
+                # wall - data stall - credited dispatch/compile - hook time
+                # (saves are credited by the engine as ckpt_stage/persist;
+                # eval falls to the other_s residual by design)
+                window_s = max(0.0, (time.monotonic() - t_iter0) - data_s
+                               - credited_blk - hooks_excl_s)
+                led.account(
+                    "rework" if s0 < self._rework_until else "productive",
+                    window_s)
             if self._preempted and step < a.max_steps:
                 logger.info("preempted at fusion boundary %d — saving and "
                             "exiting", step)
+        except BaseException:
+            # fault flight dump: ring buffer + ledger snapshot land next
+            # to the checkpoints so post-mortem tooling finds them
+            get_recorder().flush(self.ckpt.checkpoint_dir, "fault")
+            raise
         finally:
+            if self._preempted:
+                get_recorder().flush(self.ckpt.checkpoint_dir, "sigterm")
+            if self.ctx.mc is not None:
+                try:  # final cumulative snapshot (latest-wins at master)
+                    self.ctx.mc.report_goodput_ledger(led.snapshot())
+                except Exception:  # noqa: BLE001
+                    pass
             if prev_sigterm is not None:
                 try:
                     _signal.signal(_signal.SIGTERM, prev_sigterm)

@@ -326,6 +326,91 @@ class TestEnvAtTrace:
                 "DWT_FA_STREAMED"} <= vars_
 
 
+class TestWallClockDuration:
+    """wall-clock-duration (warning): time.time() in duration math."""
+
+    def test_elapsed_subtraction_flagged(self, tmp_path):
+        found = _scan_source(
+            tmp_path, "pkg/master/loop.py", """\
+            '''Parity: ref.py:1'''
+            import time
+
+            def wait(t0):
+                return time.time() - t0
+            """)
+        assert [f.checker for f in found] == ["wall-clock-duration"]
+        assert found[0].severity == "warning"
+        assert found[0].line == 5
+        assert "monotonic" in found[0].message
+
+    def test_deadline_addition_flagged(self, tmp_path):
+        found = _scan_source(
+            tmp_path, "pkg/master/loop.py", """\
+            '''Parity: ref.py:1'''
+            import time
+
+            def deadline(timeout):
+                return time.time() + timeout
+            """)
+        assert [f.checker for f in found] == ["wall-clock-duration"]
+
+    def test_file_timestamp_comparison_exempt(self, tmp_path):
+        # mtimes ARE wall clock — comparing against one is correct as is
+        found = _scan_source(
+            tmp_path, "pkg/master/loop.py", """\
+            '''Parity: ref.py:1'''
+            import os
+            import time
+
+            def age(path):
+                return time.time() - os.path.getmtime(path)
+
+            def stat_age(st):
+                return time.time() - st.st_mtime
+            """)
+        assert found == []
+
+    def test_suppression_with_reason_honored(self, tmp_path):
+        found = _scan_source(
+            tmp_path, "pkg/master/loop.py", """\
+            '''Parity: ref.py:1'''
+            import time
+
+            def journal_ts(t0):
+                return time.time() - t0  # graftlint: disable=wall-clock-duration -- cross-process journal timestamps are wall clock
+            """)
+        assert found == []
+
+    def test_monotonic_clean(self, tmp_path):
+        found = _scan_source(
+            tmp_path, "pkg/master/loop.py", """\
+            '''Parity: ref.py:1'''
+            import time
+
+            def wait(t0, timeout):
+                return (time.monotonic() - t0) < timeout
+
+            def stamp():
+                return time.time()  # bare read, no arithmetic: fine
+            """)
+        assert found == []
+
+    def test_warning_severity_does_not_gate(self, tmp_path):
+        # warnings report but keep ok=true / rc 0 (README contract)
+        from dlrover_wuqiong_tpu.analysis.__main__ import main
+
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "__init__.py").touch()
+        (pkg / "m.py").write_text(
+            "'''Parity: ref.py:1'''\n"
+            "import time\n\n\n"
+            "def wait(t0):\n"
+            "    return time.time() - t0\n")
+        rc = main(["--engine", "ast", str(tmp_path)])
+        assert rc == 0
+
+
 class TestDonatedReuse:
     def test_reuse_after_donation_flagged(self, tmp_path):
         found = _scan_source(

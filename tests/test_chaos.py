@@ -43,6 +43,13 @@ def test_ckpt_corrupt_zero_silent_restores():
     for case in report["cases"]:
         assert case["bit_identical"], case
     assert report["doctor"]["flagged_steps"] == [4]
+    # telemetry contract: a degraded restore reconstructs as ONE trace
+    # tree (ckpt:restore root + >1 tier children) from the flight dump,
+    # and the goodput ledger carries the per-tier restore credits
+    assert report["flight"]["dumps"] >= 1, report["flight"]
+    assert report["flight"]["degraded_trace_trees"] >= 1, report["flight"]
+    assert report["flight"]["ledger"]["restore_replica"] > 0
+    assert report["flight"]["ledger"]["restore_storage"] > 0
 
 
 def test_cli_runs_all(capsys):
@@ -68,6 +75,11 @@ def test_preempt_goodput_at_tuned_interval():
     assert r["ok"], r
     assert r["goodput"] >= 0.95, r
     assert len(r["kills"]) == 2, r
+    # the downtime split is GOODPUT-LEDGER-derived: one cumulative
+    # snapshot per worker generation, summed by the drill
+    assert r["ledger"]["generations"] == len(r["kills"]) + 1, r["ledger"]
+    assert r["ledger"]["states"]["productive"] > 0, r["ledger"]
+    assert r["downtime"]["restarts"] == len(r["kills"]), r["downtime"]
 
 
 @pytest.mark.slow  # tier-2: ~37s wall-clock goodput drill; preempt goodput

@@ -170,9 +170,9 @@ class TestIpc:
         assert q.get(timeout=15) == "held"
         proc.join(timeout=10)
         assert lock.locked()  # the dead holder left it held
-        t0 = time.time()
+        t0 = time.monotonic()
         assert lock.acquire(timeout=30)  # reaped, not waited out  # graftlint: disable=lock-leak -- reap-semantics test, released below
-        assert time.time() - t0 < 5.0
+        assert time.monotonic() - t0 < 5.0
         lock.release()
         lock.close()
         q.close()

@@ -61,7 +61,7 @@ class TestHangChain:
 class TestStraggler:
     def test_slow_node_flagged(self):
         data = DiagnosisDataManager()
-        base = time.time() - 1000
+        base = time.time() - 1000  # graftlint: disable=wall-clock-duration -- forging node-reported wall timestamps (DiagnosisReport)
         for i in range(10):
             _step(data, 0, base + i * 1.0)   # 1s cadence
             _step(data, 1, base + i * 1.1)
@@ -72,7 +72,7 @@ class TestStraggler:
 
     def test_uniform_cadence_clean(self):
         data = DiagnosisDataManager()
-        base = time.time() - 100
+        base = time.time() - 100  # graftlint: disable=wall-clock-duration -- forging node-reported wall timestamps (DiagnosisReport)
         for i in range(10):
             for n in range(3):
                 _step(data, n, base + i * 1.0 + n * 0.01)
@@ -99,7 +99,7 @@ class TestActionCoupling:
         node = jm.register_node(NodeType.WORKER, 0)
         node.update_status(NodeStatus.RUNNING)
         dm = DiagnosisManager(hang_timeout=1, job_manager=jm)
-        _step(dm.data, 0, time.time() - 100)
+        _step(dm.data, 0, time.time() - 100)  # graftlint: disable=wall-clock-duration -- forging node-reported wall timestamps (DiagnosisReport)
         actions = dm.diagnose_once()
         assert any(a.action == "restart_worker" for a in actions)
         assert node.restart_training  # delivered via next heartbeat
@@ -122,7 +122,7 @@ class TestActionCoupling:
 
     def test_worker_polls_pending_action(self):
         dm = DiagnosisManager(hang_timeout=1)
-        _step(dm.data, 0, time.time() - 100)
+        _step(dm.data, 0, time.time() - 100)  # graftlint: disable=wall-clock-duration -- forging node-reported wall timestamps (DiagnosisReport)
         dm.diagnose_once()
         act = dm.collect_report(msg.DiagnosisReport(
             node_id=0, payload_type="step", content="s",

@@ -307,7 +307,7 @@ class TestDeviceProber:
 class TestHangLocalization:
     def _hang_data(self, probes):
         data = DiagnosisDataManager()
-        old = time.time() - 3600
+        old = time.time() - 3600  # graftlint: disable=wall-clock-duration -- forging node-reported wall timestamps (DiagnosisReport)
         # node 1's step report is NEWEST — oldest-step heuristic alone
         # would blame node 0
         data.store_report(msg.DiagnosisReport(
@@ -341,7 +341,7 @@ class TestHangLocalization:
 
     def test_stale_probes_ignored(self):
         data = DiagnosisDataManager()
-        old = time.time() - 3600
+        old = time.time() - 3600  # graftlint: disable=wall-clock-duration -- forging node-reported wall timestamps (DiagnosisReport)
         data.store_report(msg.DiagnosisReport(
             node_id=0, payload_type="step", content="5", timestamp=old))
         data.store_report(msg.DiagnosisReport(

@@ -193,6 +193,26 @@ class TestSpeedMonitor:
         assert sm.completed_global_step == 90
         assert abs(sm.running_speed() - 10.0) < 0.01
 
+    def test_target_worker_num_readable_before_set(self):
+        # regression: _target_worker_num was only assigned by
+        # set_target_worker_num — reading it first raised AttributeError
+        sm = SpeedMonitor()
+        assert sm.target_worker_num == 0
+        assert not sm.all_worker_joined()  # 0 target = never joined
+        sm.add_running_worker(0)
+        assert not sm.all_worker_joined()
+
+    def test_all_worker_joined_semantics(self):
+        sm = SpeedMonitor()
+        sm.set_target_worker_num(2)
+        assert sm.target_worker_num == 2
+        sm.add_running_worker(0)
+        assert not sm.all_worker_joined()
+        sm.add_running_worker(1)
+        assert sm.all_worker_joined()
+        sm.remove_running_worker(1)
+        assert not sm.all_worker_joined()
+
 
 class TestMasterEndToEnd:
     """In-process master + RPC clients (reference test_elastic_training_agent

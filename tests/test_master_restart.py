@@ -528,8 +528,8 @@ class TestSubprocessMasterRestart:
 
         try:
             spawn(0)
-            deadline = time.time() + 30
-            while time.time() < deadline and \
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and \
                     not comm.addr_connectable(f"127.0.0.1:{port}"):
                 time.sleep(0.1)
             mc = MasterClient(f"127.0.0.1:{port}", node_id=0,

@@ -139,8 +139,8 @@ class TestRealMasterProcess:
         ctl.master_factory = factory
         store.submit(ElasticJob("real1"))
         ctl.reconcile_once()
-        deadline = time.time() + 20
-        while time.time() < deadline:
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
             ctl.reconcile_once()
             if store.list_jobs()[0].phase in (JobPhase.SUCCEEDED,
                                               JobPhase.FAILED):

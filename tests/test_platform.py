@@ -34,8 +34,8 @@ from dlrover_wuqiong_tpu.scheduler import (
 
 
 def _wait(cond, timeout=10.0, interval=0.05):
-    deadline = time.time() + timeout
-    while time.time() < deadline:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
         if cond():
             return True
         time.sleep(interval)
@@ -75,8 +75,8 @@ class TestSchedulerBackends:
         c.create_node(NodeSpec(NodeType.WORKER, 1,
                                command=[sys.executable, "-c", "exit(3)"]))
         events = []
-        deadline = time.time() + 10
-        while time.time() < deadline:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
             events += list(c.watch(timeout=0.3))
             if any(e.node.status == NodeStatus.FAILED for e in events):
                 break

@@ -174,13 +174,13 @@ class TestStepProfilerIntegration:
         )
 
         data = DiagnosisDataManager()
-        old = time.time() - 3600
+        old = time.time() - 3600  # graftlint: disable=wall-clock-duration -- forging node-reported wall timestamps (DiagnosisReport)
         data.store_report(msg.DiagnosisReport(
             node_id=0, payload_type="step", content="5", timestamp=old))
         data.store_report(msg.DiagnosisReport(
             node_id=0, payload_type="op_profile",
             content='[{"op": "all-reduce", "seconds": 1.5, "count": 3}]',
-            timestamp=time.time() - 100))
+            timestamp=time.time() - 100))  # graftlint: disable=wall-clock-duration -- forging node-reported wall timestamps (DiagnosisReport)
         # stale evidence (older than max_age) is withheld
         assert data.node_op_profile(0, max_age=10) == ""
         chain = InferenceChain([CheckTrainingHangOperator(timeout=60),

@@ -1,0 +1,120 @@
+"""Goodput-ledger report: ONE JSON line for the driver/operator.
+
+Two sources, same shape (telemetry/ledger.py snapshot schema):
+
+    python tools/goodput_report.py [--addr HOST:PORT]   # live master RPC
+    python tools/goodput_report.py --flight CKPT_DIR    # offline dumps
+
+Live mode pulls the job-level aggregation the master keeps from each
+node's BUFFERED GoodputLedgerReport (latest cumulative snapshot per
+node, summed across nodes — master/master.py goodput_summary).  The
+address defaults to DWT_MASTER_ADDR.
+
+Offline mode reads the flight-recorder dumps under $CKPT_DIR/flight/
+(written on fault/SIGTERM/drill flush): the LATEST embedded ledger per
+(role, pid) is summed, and span events are counted so a post-mortem can
+see at a glance whether the dumps carry a reconstructable trace tree
+(`tools/goodput_report.py --flight` is the post-mortem entry point; the
+Chrome-trace export for one trace is telemetry/spans.py
+dump_chrome_trace).
+
+Fields: states (seconds per ledger state), wall_s, other_s (residual),
+goodput_fraction, nodes (reporting processes), plus source bookkeeping.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _from_master(addr: str) -> dict:
+    from dlrover_wuqiong_tpu.agent.master_client import MasterClient
+
+    mc = MasterClient(addr, node_id=-1)
+    try:
+        s = mc.get_goodput_summary()
+    finally:
+        mc.close()
+    return {
+        "source": "master", "addr": addr, "nodes": s.nodes,
+        "wall_s": round(s.wall_s, 3),
+        "states": {k: round(v, 3) for k, v in sorted(s.states.items())},
+        "other_s": round(s.other_s, 3),
+        "goodput_fraction": round(s.goodput_fraction, 4),
+    }
+
+
+def _from_flight(ckpt_dir: str) -> dict:
+    from dlrover_wuqiong_tpu.telemetry import load_flight_dumps
+
+    dumps = load_flight_dumps(ckpt_dir)
+    # a process may have flushed several times — its ledger snapshots
+    # are cumulative, so only the LATEST per (role, pid) counts
+    latest = {}
+    spans = traces = 0
+    for d in dumps:
+        if d.get("ledger"):
+            latest[(d.get("role"), d.get("pid"))] = d["ledger"]
+        for e in d.get("events", []):
+            if e.get("kind") == "span":
+                spans += 1
+    trace_ids = {e["data"].get("trace_id")
+                 for d in dumps for e in d.get("events", [])
+                 if e.get("kind") == "span" and e.get("data")}
+    traces = len(trace_ids - {None, ""})
+    states = {}
+    wall = other = 0.0
+    for led in latest.values():
+        wall += float(led.get("wall_s", 0.0))
+        other += float(led.get("other_s", 0.0))
+        for k, v in led.get("states", {}).items():
+            states[k] = states.get(k, 0.0) + float(v)
+    productive = states.get("productive", 0.0)
+    total = max(wall, sum(states.values()))
+    return {
+        "source": "flight", "ckpt_dir": ckpt_dir, "dumps": len(dumps),
+        "nodes": len(latest),
+        "wall_s": round(wall, 3),
+        "states": {k: round(v, 3) for k, v in sorted(states.items())},
+        "other_s": round(other, 3),
+        "goodput_fraction": round(
+            (productive / total) if total > 0 else 0.0, 4),
+        "spans": spans, "traces": traces,
+    }
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    flight = addr = None
+    it = iter(argv)
+    for a in it:
+        if a == "--flight":
+            flight = next(it, None)
+        elif a == "--addr":
+            addr = next(it, None)
+        elif a in ("-h", "--help"):
+            print(__doc__, file=sys.stderr)
+            return 0
+    try:
+        if flight:
+            report = _from_flight(flight)
+        else:
+            addr = addr or os.getenv("DWT_MASTER_ADDR", "")
+            if not addr:
+                print(json.dumps({"error": "no master address: pass "
+                                  "--addr, set DWT_MASTER_ADDR, or use "
+                                  "--flight CKPT_DIR"}))
+                return 2
+            report = _from_master(addr)
+    except Exception as e:  # noqa: BLE001 — the JSON contract beats purity
+        print(json.dumps({"error": repr(e)[:500]}))
+        return 1
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -59,7 +59,7 @@ def main():
     batch = int(cfg_in.get("batch", n_dev))
     strategy = [tuple(s) for s in cfg_in["strategy"]]
 
-    t0 = time.time()
+    t0 = time.monotonic()
     res = auto_accelerate(Llama(cfg), optimizer=optax.adamw(3e-4),
                           strategy=strategy, materialize=False, seq_len=seq)
     bsh = res.batch_sharding_fn(2, None, 0)
@@ -74,7 +74,7 @@ def main():
         "mesh": res.strategy.plan.describe(),
         "params": cfg.num_params(),
         "seq": seq, "batch": batch, "n_devices": n_dev,
-        "compile_s": round(time.time() - t0, 1),
+        "compile_s": round(time.monotonic() - t0, 1),
         "arg_gib": round(ma.argument_size_in_bytes / 2**30, 3),
         "out_gib": round(ma.output_size_in_bytes / 2**30, 3),
         "alias_gib": round(ma.alias_size_in_bytes / 2**30, 3),
