@@ -102,6 +102,7 @@ class ElasticAgent:
         self._replica_manager = None
         self._warm_pool = None
         self._warm_generation = 0  # invalidates stale warm threads
+        self._policy_seen = 0  # last adaptive-policy decision id applied
         # last rendezvous round this agent ran in, PER rendezvous name
         # (network-check and elastic-training managers count independently):
         # a re-join after failure must wait for a NEWER round — accepting
@@ -372,10 +373,30 @@ class ElasticAgent:
                             self._stop_worker()
                 except Exception:  # noqa: BLE001
                     logger.warning("heartbeat failed", exc_info=True)
+                try:
+                    self._apply_policy_knobs()
+                except Exception:  # noqa: BLE001 — knob pickup is
+                    pass           # best-effort, never kills the heartbeat
 
         self._heartbeat_thread = threading.Thread(
             target=_loop, daemon=True, name="dwt-agent-heartbeat")
         self._heartbeat_thread.start()
+
+    def _apply_policy_knobs(self):
+        """Heartbeat-cadence pickup of the agent-owned policy knob: the
+        replica ring fan-out (the trainer owns cadence/fused-K/tier —
+        it applies them at fusion boundaries).  Decision ids are
+        monotonic, so a replayed master re-serves the same decision and
+        the dedup keeps this idempotent."""
+        if self._replica_manager is None:
+            return
+        d = self.mc.get_policy_decision()
+        did = int(getattr(d, "decision_id", 0) or 0)
+        if did <= self._policy_seen:
+            return
+        self._policy_seen = did
+        if int(getattr(d, "replica_count", -1)) >= 0:
+            self._replica_manager.set_replica_count(d.replica_count)
 
     # --------------------------------------------------------------- run loop
 

@@ -364,12 +364,40 @@ class MasterClient:
                         for k, v in snapshot.get("states", {}).items()},
                 other_s=float(snapshot.get("other_s", 0.0)),
                 goodput_fraction=float(
-                    snapshot.get("goodput_fraction", 0.0))),
+                    snapshot.get("goodput_fraction", 0.0)),
+                sent_at=time.time()),
             default=msg.OkResponse())
 
     def get_goodput_summary(self) -> msg.GoodputSummary:
         """Job-level ledger aggregation (tools/goodput_report.py)."""
         return self._call_polling("get", msg.GoodputQuery())
+
+    # ------------------------------------------------------ adaptive policy
+
+    def report_policy_decision(self, decision: msg.PolicyDecision
+                               ) -> msg.PolicyDecisionAck:
+        """Submit an externally computed decision (drills/operators) —
+        CRITICAL + idem: the master journals it before acking, and a
+        retry crossing a restart replays the ack."""
+        return self._call_critical(
+            "report",
+            msg.PolicyDecisionReport(node_id=self.node_id,
+                                     decision=decision),
+            idem=self._next_idem())
+
+    def get_policy_decision(self) -> msg.PolicyDecision:
+        """Latest adaptive-policy decision; polled by the trainer at
+        fusion boundaries (fail fast — the next boundary retries)."""
+        return self._call_polling(
+            "get", msg.PolicyStateRequest(node_id=self.node_id))
+
+    def get_policy_history(self) -> List[Dict]:
+        """Full decision history (journal-backed, oldest first)."""
+        import json
+
+        resp = self._call_polling(
+            "get", msg.PolicyHistoryRequest(node_id=self.node_id))
+        return json.loads(resp.content) if resp.content else []
 
     def report_diagnosis(self, payload_type: str,
                          content: str) -> msg.DiagnosisAction:

@@ -66,14 +66,14 @@ from .findings import Finding, is_suppressed
 JOURNALED_VERBS = {
     "TaskRequest", "KVStoreAddRequest", "JoinRendezvousRequest",
     "TaskResult", "DatasetShardParams", "NodeMeta", "NodeFailure",
-    "KVStoreSetRequest", "ShardCheckpoint",
+    "KVStoreSetRequest", "ShardCheckpoint", "PolicyDecisionReport",
 }
 
 #: verbs that are NOT naturally idempotent across a master restart: the
 #: idem key + journaled response make their retries at-most-once.
 IDEM_VERBS = {
     "TaskRequest", "KVStoreAddRequest", "JoinRendezvousRequest",
-    "TaskResult",
+    "TaskResult", "PolicyDecisionReport",
 }
 
 #: names whose (transitive) call means "a manifest was published".

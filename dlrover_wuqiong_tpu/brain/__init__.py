@@ -14,6 +14,10 @@ start from the fleet prior instead of cold defaults.
 """
 
 from .client import BrainClient, BrainResourceOptimizer
+from .policy import (PolicyConfig, PolicyEngine, PreemptionRateEstimator,
+                     load_prior)
 from .service import BrainService
 
-__all__ = ["BrainClient", "BrainResourceOptimizer", "BrainService"]
+__all__ = ["BrainClient", "BrainResourceOptimizer", "BrainService",
+           "PolicyConfig", "PolicyEngine", "PreemptionRateEstimator",
+           "load_prior"]

@@ -315,6 +315,56 @@ def _oom_resource(samples, fleet_samples, cfg) -> NodeResource:
                       max(base.memory_mb, peak) * cfg["oom_factor"]))
 
 
+# ----------------------------------------------- fault-tolerance policy
+# Same registry, different domain: these back brain/policy.py's four
+# knobs.  `samples`/`fleet_samples` stay in the signature for registry
+# uniformity; the failure-regime inputs ride `cfg` (policy.PolicyConfig
+# .algo_cfg) because the regime is an EWMA over journal events, not a
+# usage-sample list.
+
+
+@register_algorithm("optimize_job_ckpt_interval")
+def _ckpt_interval(samples, fleet_samples, cfg) -> int:
+    """Young–Daly cadence: sqrt(2·C·MTBF) seconds, bounded, in steps."""
+    import math
+
+    mtbf = min(cfg["mtbf_s"], 1e9)  # inf MTBF still yields a finite cap
+    sec = math.sqrt(2.0 * max(1e-6, cfg["ckpt_cost_s"]) * max(1e-3, mtbf))
+    steps = int(round(sec / max(1e-6, cfg["step_time_s"])))
+    return max(cfg["min_interval_steps"],
+               min(cfg["max_interval_steps"], steps))
+
+
+@register_algorithm("optimize_job_fused_steps")
+def _fused_steps(samples, fleet_samples, cfg) -> int:
+    """Dispatch amortization vs rework exposure: a kill mid-window
+    replays up to K-1 steps, so K climbs the ladder only as MTBF does."""
+    for k, floor_s in cfg["fused_ladder"]:
+        if cfg["mtbf_s"] >= floor_s:
+            return int(k)
+    return 1
+
+
+@register_algorithm("optimize_job_replica_count")
+def _replica_count(samples, fleet_samples, cfg) -> int:
+    """The peer-replica ring only pays when node loss is likely inside a
+    checkpoint window."""
+    want = 2 if cfg["mtbf_s"] < cfg["replica_mtbf_s"] else 1
+    return max(1, min(int(cfg["max_replicas"]), want))
+
+
+@register_algorithm("optimize_job_recovery_route")
+def _recovery_route(samples, fleet_samples, cfg):
+    """→ (route, preferred restore tier).  Keep the warm pool hot while
+    failures are frequent; prefer the replica tier once the ring exists
+    (shm dies with the node, storage is transfer-bound — PHOENIX)."""
+    route = "warm" if cfg["mtbf_s"] < cfg["warm_mtbf_s"] else "cold"
+    tier = "replica" if (cfg.get("replica_count", 1) >= 2
+                         and cfg["mtbf_s"] < cfg["replica_mtbf_s"]) \
+        else "shm"
+    return route, tier
+
+
 # ----------------------------------------------------------------- optimizer
 
 

@@ -13,6 +13,7 @@ it is equally a CI test body (tests/test_chaos.py) and an operator tool:
     python -m dlrover_wuqiong_tpu.chaos network-partition
     python -m dlrover_wuqiong_tpu.chaos preempt-warm   # re-mesh compile win
     python -m dlrover_wuqiong_tpu.chaos preempt-fused  # K-step boundaries
+    python -m dlrover_wuqiong_tpu.chaos preempt-adaptive  # policy loop
 
 pod-kill drives the REAL stack — `run` CLI → master → agent → worker with
 flash checkpoints — and hard-SIGKILLs the worker process group externally
@@ -440,12 +441,23 @@ with open(os.path.join(marker_dir, "done"), "w") as f:
 """
 
 
+def _read_last_step(steps_log: str) -> int:
+    """Newest executed step in a drill worker's shared steps.log."""
+    try:
+        with open(steps_log) as f:
+            lines = f.read().splitlines()
+        return int(lines[-1].split()[1]) if lines else -1
+    except (OSError, ValueError, IndexError):
+        return -1
+
+
 def preempt(total_steps: int = 600, dt: float = 0.1,
             ckpt_interval: int = 50, kills: int = 2, seed: int = 0,
             flash: bool = True, target: float = 0.95,
             timeout: float = 420.0, model: bool = False,
             cache_dir: str = "", compile_cache: bool = True,
-            fused_steps: int = 1) -> Dict:
+            fused_steps: int = 1, kill_at_steps=None,
+            relaunch_always: bool = False) -> Dict:
     """Randomized preemption drill against the goodput north star.
 
     N SIGKILLs land at seeded-random times over the run; goodput is
@@ -478,10 +490,25 @@ def preempt(total_steps: int = 600, dt: float = 0.1,
     the drill proves the boundary-only elastic contract still meets the
     goodput target (loss per kill bounded by K + restart latency, not by
     the disk interval).
+
+    `kill_at_steps=[s1, s2, ...]` replaces the seeded wall-clock schedule
+    with STEP-triggered kills: each SIGKILL lands once the worker's
+    shared step log crosses the threshold.  Two runs (e.g. adaptive vs
+    static cadence in `preempt_adaptive`) then take faults at identical
+    step positions, so their goodput difference isolates the cadence
+    policy from restart-latency jitter.
+
+    `relaunch_always=True` disables the master's repeated-error-class
+    cutoff for the drill: a SIGKILL burst classifies as `host_oom`
+    (exit_code=137 is ambiguous), and three consecutive kills would
+    otherwise stop relaunching — but a drill kill IS the preemption
+    storm the cutoff's TRANSIENT_CLASSES carve-out exists for.
     """
     import random
 
     extra_env = {}
+    if relaunch_always:
+        extra_env["DWT_CTX_RELAUNCH_ALWAYS"] = "1"
     if model:
         extra_env["DWT_COMPILE_CACHE"] = "1" if compile_cache else "0"
         if cache_dir:
@@ -493,21 +520,36 @@ def preempt(total_steps: int = 600, dt: float = 0.1,
          "1" if model else "0", max(1, fused_steps)],
         max_restarts=kills + 1, extra_env=extra_env)
 
-    # seeded kill schedule: uniform over the productive middle of the run
+    # kill schedule: seeded wall-clock times over the productive middle,
+    # or explicit step thresholds when kill_at_steps pins the positions
     ideal = total_steps * dt
-    rng = random.Random(seed)
-    kill_times = sorted(rng.uniform(0.15, 0.75) * ideal
-                        for _ in range(kills))
+    steps_log = os.path.join(marker, "steps.log")
+    if kill_at_steps is not None:
+        schedule = [("step", int(s)) for s in sorted(kill_at_steps)]
+        kills = len(schedule)
+    else:
+        rng = random.Random(seed)
+        schedule = [("time", t) for t in
+                    sorted(rng.uniform(0.15, 0.75) * ideal
+                           for _ in range(kills))]
     killed = []
-    for kt in kill_times:
-        delay = t_start + kt - time.monotonic()
-        if delay > 0:
-            time.sleep(delay)
+    for mode, when in schedule:
+        if mode == "time":
+            delay = t_start + when - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
         # wait out worker startup/restart: a kill scheduled before the
-        # (re)launched worker wrote its pid must land, not be skipped
+        # (re)launched worker wrote its pid must land, not be skipped.
+        # Step-triggered kills additionally wait for the step log to
+        # cross the threshold (rework included).
         pid = None
-        wait_pid = time.monotonic() + 60.0
+        wait_pid = time.monotonic() + (
+            60.0 if mode == "time"
+            else max(30.0, t_start + timeout * 0.75 - time.monotonic()))
         while time.monotonic() < wait_pid and cli.poll() is None:
+            if mode == "step" and _read_last_step(steps_log) < when:
+                time.sleep(0.05)
+                continue
             pids = sorted((f for f in os.listdir(marker)
                            if f.startswith("pid_r")),
                           key=lambda s: int(s[5:]))
@@ -528,6 +570,7 @@ def preempt(total_steps: int = 600, dt: float = 0.1,
         try:
             os.kill(pid, signal.SIGKILL)
             killed.append({"t": round(time.monotonic() - t_start, 1),
+                           "at_step": _read_last_step(steps_log),
                            "pid": pid})
         except OSError:
             pass
@@ -620,12 +663,19 @@ def preempt(total_steps: int = 600, dt: float = 0.1,
 
 
 def preempt_table(total_steps: int = 600, dt: float = 0.1,
-                  kills: int = 2, seed: int = 0) -> Dict:
+                  kills: int = 2, seed: int = 0,
+                  out_dir: str = "") -> Dict:
     """The interval-vs-goodput curve (README): disk-only cadence at
     several intervals vs flash per-step staging, then two REAL-compile
     rows (model=True) contrasting warm vs cold restart compile cost —
     the downtime split makes the warm-pool win visible per-component,
-    not just in aggregate goodput."""
+    not just in aggregate goodput.
+
+    The curve is also the adaptive-policy engine's OFFLINE PRIOR
+    (brain/policy.py load_prior calibrates step time + checkpoint cost
+    from it): rows persist atomically to `out_dir/policy/
+    preempt_table.json` (default `$DWT_CKPT_DIR` or the system tmp dir)
+    and the report carries `table_path` for `--policy-prior`."""
     rows = []
     # (interval, flash, model, compile_cache)
     grid = [(200, False, False, True), (50, False, False, True),
@@ -654,9 +704,24 @@ def preempt_table(total_steps: int = 600, dt: float = 0.1,
             shutil.rmtree(cache, ignore_errors=True)
     # a row where a scheduled kill never landed is NOT a valid curve
     # point — its goodput would be inflated silently
-    return {"scenario": "preempt-table", "rows": rows,
-            "ok": all(r["completed"] and r["kills_landed"] == kills
-                      for r in rows)}
+    report = {"scenario": "preempt-table", "rows": rows,
+              "ok": all(r["completed"] and r["kills_landed"] == kills
+                        for r in rows)}
+    base = out_dir or os.getenv("DWT_CKPT_DIR", "") or tempfile.gettempdir()
+    path = os.path.join(base, "policy", "preempt_table.json")
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"dt": dt, "total_steps": total_steps,
+                       "kills": kills, "rows": rows}, f)
+        os.replace(tmp, path)  # a crashed writer never tears the prior
+        report["table_path"] = path
+    except OSError:
+        logger.warning("preempt-table: persisting %s failed", path,
+                       exc_info=True)
+        report["table_path"] = ""
+    return report
 
 
 def preempt_fused(total_steps: int = 300, dt: float = 0.05,
@@ -716,6 +781,556 @@ def preempt_warm(total_steps: int = 120, dt: float = 0.05,
         and cold["downtime"]["warm_restarts"] == 0
         and saved > 0)
     return report
+
+
+# ---------------------------------------------------------- preempt adaptive
+
+
+_ADAPTIVE_WORKER = r"""
+import dataclasses, json, os, sys, time
+import numpy as np
+
+from dlrover_wuqiong_tpu.trainer.elastic import init_elastic
+from dlrover_wuqiong_tpu.checkpoint.checkpointer import (
+    FlashCheckpointer, StorageType)
+from dlrover_wuqiong_tpu.telemetry import get_ledger
+
+(ckpt_dir, marker_dir, total_steps, dt, poll_steps, interval0) = (
+    sys.argv[1], sys.argv[2], int(sys.argv[3]), float(sys.argv[4]),
+    int(sys.argv[5]), int(sys.argv[6]))
+ctx = init_elastic()
+restart = ctx.world.restart_count
+led = get_ledger()
+led.start()
+extra = {"restart": restart, "start_hits": 0, "start_misses": 0,
+         "kchange_hits": 0, "kchange_misses": 0, "kchanges": [],
+         "decisions": []}
+ledger_path = os.path.join(marker_dir, f"ledger_r{restart}.json")
+
+
+def dump_ledger():
+    snap = dict(led.snapshot(), **extra)
+    tmp = ledger_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(snap, f)
+    os.replace(tmp, ledger_path)
+
+
+# real model through the persistent compile cache, same build the
+# warm-pool child replays (optax.adamw(3e-4), nano GPT, fsdp, abstract
+# [8, 32] batch): the drill pre-warms the pool, so EVERY generation's
+# startup compile and every policy fused-K switch must be cache HITS
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import optax
+from dlrover_wuqiong_tpu.auto.accelerate import auto_accelerate
+from dlrover_wuqiong_tpu.auto.compile_cache import counters
+from dlrover_wuqiong_tpu.auto.warm_pool import WarmPool, WarmSpec, model_spec
+from dlrover_wuqiong_tpu.models.gpt import GPT, GPTConfig
+
+cfg = dataclasses.replace(GPTConfig.nano(), dtype=jnp.float32,
+                          use_flash_attention=False, remat=False)
+model = GPT(cfg)
+h0, m0 = counters.snapshot()
+with led.window("compile"):
+    res = auto_accelerate(model, optimizer=optax.adamw(3e-4),
+                          devices=jax.devices(), strategy=[("fsdp", {})],
+                          materialize=False)
+    bsh = res.batch_sharding_fn(2, None, 0)
+    ab = {"input_ids": jax.ShapeDtypeStruct((8, 32), jnp.int32,
+                                            sharding=bsh),
+          "labels": jax.ShapeDtypeStruct((8, 32), jnp.int32,
+                                         sharding=bsh)}
+    res.train_step.lower(res.state, ab).compile()
+h1, m1 = counters.snapshot()
+extra.update(start_hits=h1 - h0, start_misses=m1 - m0)
+pool = WarmPool(os.environ["DWT_COMPILE_CACHE_DIR"])
+knobs = {"interval": interval0, "cur_k": 1, "pending_k": None,
+         "last_id": 0}
+
+
+def spec_at(k):
+    return WarmSpec(n_devices=len(jax.devices()),
+                    strategy=[["fsdp", {}]], model=model_spec(model),
+                    batch_shape=[8, 32], platform="cpu", fused_steps=k)
+
+
+def switch_k(k):
+    # fused-K cutover contract (trainer._prewarm_fused_k): only when the
+    # pool holds a READY entry at the new K — otherwise kick a warm
+    # compile and stay at the current K until a later boundary
+    if pool._ready_entry_for(spec_at(k).spec_key()) is None:
+        pool.warm_async(spec_at(k))
+        return False
+    hh0, mm0 = counters.snapshot()
+    with led.window("compile"):
+        bshk = res.batch_sharding_fn(3, None, 1)
+        abk = {"input_ids": jax.ShapeDtypeStruct((k, 8, 32), jnp.int32,
+                                                 sharding=bshk),
+               "labels": jax.ShapeDtypeStruct((k, 8, 32), jnp.int32,
+                                              sharding=bshk)}
+        res.fused_train_step(k).lower(res.state, abk).compile()
+    hh1, mm1 = counters.snapshot()
+    extra["kchange_hits"] += hh1 - hh0
+    extra["kchange_misses"] += mm1 - mm0
+    extra["kchanges"].append({"k": k, "hits": hh1 - hh0,
+                              "misses": mm1 - mm0})
+    return True
+
+
+dlog = open(os.path.join(marker_dir, "decisions.log"), "a")
+
+
+def poll_policy():
+    try:
+        d = ctx.mc.get_policy_decision()
+    except Exception:  # master outage: next boundary retries
+        return
+    if d.decision_id <= knobs["last_id"]:
+        return
+    knobs["last_id"] = d.decision_id
+    seen = {"id": d.decision_id, "interval": d.ckpt_interval_steps,
+            "fused": d.fused_steps, "replicas": d.replica_count,
+            "route": d.recovery_route, "tier": d.preferred_tier,
+            "restart": restart}
+    extra["decisions"].append(seen)
+    dlog.write(json.dumps(seen) + "\n")
+    dlog.flush()
+    if d.ckpt_interval_steps > 0:
+        knobs["interval"] = d.ckpt_interval_steps
+    if d.fused_steps > 1 and d.fused_steps != knobs["cur_k"]:
+        knobs["pending_k"] = d.fused_steps
+    elif d.fused_steps == 1:
+        knobs["cur_k"] = 1
+        knobs["pending_k"] = None
+
+
+ckpt = FlashCheckpointer(ckpt_dir, job_name=os.environ["DWT_JOB_NAME"])
+template = {"w": np.zeros((8, 8), np.float32),
+            "step": np.zeros((), np.int64)}
+state = ckpt.load_checkpoint(template)
+start = int(state["step"]) + 1 if state is not None else 0
+extra["start_step"] = start
+prev_max = -1
+try:
+    with open(os.path.join(marker_dir, "steps.log")) as f:
+        for ln in f:
+            prev_max = max(prev_max, int(ln.split()[1]))
+except (OSError, ValueError, IndexError):
+    pass
+poll_policy()  # a restarted generation adopts the live cadence at once
+dump_ledger()
+with open(os.path.join(marker_dir, f"pid_r{restart}"), "w") as f:
+    f.write(str(os.getpid()))
+log = open(os.path.join(marker_dir, "steps.log"), "a")
+step = start - 1
+s = start
+while s < total_steps:
+    if knobs["pending_k"] is not None and switch_k(knobs["pending_k"]):
+        knobs["cur_k"] = knobs["pending_k"]
+        knobs["pending_k"] = None
+    k = knobs["cur_k"]
+    k_eff = min(k - s % k, total_steps - s)
+    n_rework = max(0, min(s + k_eff, prev_max + 1) - s)
+    if n_rework:
+        with led.window("rework"):
+            time.sleep(dt * n_rework)
+    if k_eff - n_rework:
+        with led.window("productive"):
+            time.sleep(dt * (k_eff - n_rework))
+    step = s + k_eff - 1
+    if any((s + i) % knobs["interval"] == 0 for i in range(k_eff)) or \
+            step == total_steps - 1:
+        sd = {"w": np.full((8, 8), float(step), np.float32),
+              "step": np.int64(step)}
+        ckpt.save_checkpoint(step, sd, storage_type=StorageType.DISK)
+    for i in range(k_eff):
+        log.write(f"{time.time()} {s + i} {restart}\n")
+    log.flush()
+    ctx.report_step(step)
+    if any((s + i) % poll_steps == 0 for i in range(k_eff)):
+        poll_policy()
+    dump_ledger()
+    s += k_eff
+ok = ckpt.wait_latest_checkpoint(60)
+dump_ledger()
+with open(os.path.join(marker_dir, "done"), "w") as f:
+    f.write(f"{ok} {step}")
+"""
+
+
+def _ledger_goodput(states: Dict) -> float:
+    """Goodput from the GOODPUT LEDGER's own attribution (productive vs
+    re-executed work), not drill timers: generations are disjoint
+    processes, so summed cumulative snapshots divide exactly."""
+    productive = float(states.get("productive", 0.0))
+    rework = float(states.get("rework", 0.0))
+    total = productive + rework
+    return round(productive / total, 4) if total > 0 else 0.0
+
+
+def preempt_adaptive(total_steps: int = 600, dt: float = 0.05,
+                     kill_at_steps=(260, 330, 390),
+                     static_interval: int = 200, margin: float = 0.08,
+                     floor: float = 0.7, policy_prior: str = "",
+                     timeout: float = 420.0) -> Dict:
+    """Closed-loop acceptance drill: adaptive policy vs static cadence.
+
+    The failure regime shifts mid-run — quiet, then a kill burst at
+    fixed STEP positions, then quiet again (the 1%/hr → 10%/hr → 1%/hr
+    pattern scaled to drill time).  Two runs take the identical fault
+    schedule:
+
+    - **baseline**: `preempt()` at the static `static_interval` cadence;
+    - **adaptive**: the real stack with a SEPARATE journaled master
+      running the policy engine (`--policy`), seeded from a
+      preempt-table prior (`--policy-prior`); each worker SIGKILL feeds
+      the EWMA preemption-rate estimator through the agent's
+      NodeFailure report, and the worker adopts the re-tuned cadence /
+      fused-K at fusion boundaries.
+
+    Invariants:
+
+    - adaptive goodput beats baseline by >= `margin` (and clears
+      `floor`) on BOTH metrics — the gated one is ledger-derived
+      (productive vs rework, the runtime's own attribution) with step
+      accounting as a cross-check: the burst collapses the Young–Daly interval,
+      so re-executed work shrinks while the static run keeps losing up
+      to `static_interval` steps per kill;
+    - the decision history TIGHTENS under the burst (min interval below
+      the first quiet-regime decision) and raises protection (replica
+      ring + warm route);
+    - fused-K switches NEVER pay a cold compile: every generation's
+      startup and every K cutover is served by the pre-warmed pool
+      (compile-cache miss counters stay zero);
+    - the master is SIGKILLed mid-run after the burst and restarted on
+      the same journal: the decision history served afterwards preserves
+      the pre-kill prefix, and the full history is reconstructable from
+      the journal files alone (offline `MasterJournal.load`).
+    """
+    from .common.comm import addr_connectable, find_free_port
+
+    kill_at_steps = sorted(int(s) for s in kill_at_steps)
+    kills = len(kill_at_steps)
+    report: Dict = {"scenario": "preempt-adaptive",
+                    "kill_at_steps": kill_at_steps,
+                    "static_interval": static_interval, "margin": margin}
+
+    # ---- static-cadence baseline on the identical fault schedule
+    baseline = preempt(total_steps=total_steps, dt=dt,
+                       ckpt_interval=static_interval, flash=False,
+                       target=0.0, timeout=timeout,
+                       kill_at_steps=kill_at_steps, relaunch_always=True)
+    report["baseline"] = {k: baseline.get(k) for k in
+                          ("goodput", "goodput_wall", "executed_steps",
+                           "completed", "cli_rc")}
+    report["baseline"]["goodput_ledger"] = _ledger_goodput(
+        baseline.get("ledger", {}).get("states", {}))
+    report["baseline_kills_landed"] = len(baseline.get("kills", []))
+
+    # ---- pre-warm the pool at K=1 and the quiet-regime ladder K so the
+    # adaptive worker's startup and fused-K cutovers are cache hits
+    import dataclasses as _dc
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from .auto.warm_pool import WarmPool, WarmSpec, model_spec
+    from .models.gpt import GPT, GPTConfig
+
+    cache = tempfile.mkdtemp(prefix="dwt-adaptive-cache-")
+    mspec = model_spec(GPT(_dc.replace(
+        GPTConfig.nano(), dtype=jnp.float32, use_flash_attention=False,
+        remat=False)))
+    n_dev = len(jax.devices())
+    pool = WarmPool(cache)
+    for k in (1, 4):
+        pool.warm_async(WarmSpec(
+            n_devices=n_dev, strategy=[["fsdp", {}]], model=mspec,
+            batch_shape=[8, 32], platform="cpu", fused_steps=k))
+    if not pool.wait(timeout=300):
+        report.update(ok=False, error="warm-pool prewarm failed",
+                      pool=pool.status())
+        return report
+
+    # ---- adaptive run: journaled master with the policy engine
+    work = tempfile.mkdtemp(prefix="dwt-chaos-adaptive-")
+    marker = os.path.join(work, "markers")
+    journal_dir = os.path.join(work, "journal")
+    os.makedirs(marker)
+    script = os.path.join(work, "worker.py")
+    with open(script, "w") as f:
+        f.write(_ADAPTIVE_WORKER)
+    prior = policy_prior
+    if not prior:
+        # drill-scale prior: the same shape preempt_table persists, with
+        # regime thresholds sized for a ~minute-long run (config block —
+        # brain/policy.py load_prior).  Curve rows calibrate C≈0.1s.
+        prior = os.path.join(work, "prior.json")
+        with open(prior, "w") as f:
+            json.dump({
+                "dt": dt, "kills": kills,
+                "rows": [{"interval": 10, "goodput": 0.78},
+                         {"interval": 200, "goodput": 0.97}],
+                "config": {"tau_s": 20.0, "min_interval_steps": 10,
+                           "max_interval_steps": static_interval,
+                           "replica_mtbf_s": 60.0, "warm_mtbf_s": 300.0,
+                           "hysteresis": 0.2,
+                           "fused_ladder": [[4, 300.0]]},
+            }, f)
+    global _launch_seq
+    _launch_seq += 1
+    job = f"adaptive{os.getpid()}n{_launch_seq}"
+    port = find_free_port()
+    addr = f"127.0.0.1:{port}"
+    env = dict(
+        os.environ, DWT_JOB_NAME=job, JAX_PLATFORMS="cpu",
+        DWT_SOCKET_DIR=os.path.join(work, "sockets"),
+        # the kill burst is a preemption storm, not a crash loop: keep
+        # relaunching through 3 consecutive SIGKILLs (same as baseline)
+        DWT_CTX_RELAUNCH_ALWAYS="1",
+        DWT_COMPILE_CACHE="1", DWT_COMPILE_CACHE_DIR=cache,
+        PYTHONPATH=os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))) + os.pathsep +
+        os.environ.get("PYTHONPATH", ""))
+
+    def spawn_master():
+        return subprocess.Popen(
+            [sys.executable, "-m", "dlrover_wuqiong_tpu.master",
+             f"--port={port}", "--min_nodes=1", "--max_nodes=1",
+             f"--journal-dir={journal_dir}", "--poll-interval=0.25",
+             "--policy", f"--policy-prior={prior}"],
+            env=env, cwd=work, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+
+    t_start = time.monotonic()
+    master = spawn_master()
+    cli = None
+    out = ""
+    tightened = protected = prefix_ok = False
+    try:
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and not addr_connectable(addr):
+            time.sleep(0.1)
+        if not addr_connectable(addr):
+            report.update(ok=False, error="master never came up")
+            return report
+        cli_env = dict(env, DWT_MASTER_ADDR=addr)
+        cli = subprocess.Popen(
+            [sys.executable, "-m", "dlrover_wuqiong_tpu.run",
+             "--nnodes=1", "--nproc_per_node=1",
+             f"--max_restarts={kills + 1}", script,
+             os.path.join(work, "ckpt"), marker, str(total_steps),
+             str(dt), "10", str(static_interval)],
+            env=cli_env, cwd=work, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+
+        # step-triggered kill burst, identical to the baseline schedule
+        steps_log = os.path.join(marker, "steps.log")
+        killed = []
+        for threshold in kill_at_steps:
+            pid = None
+            wait_pid = time.monotonic() + max(
+                30.0, t_start + timeout * 0.75 - time.monotonic())
+            while time.monotonic() < wait_pid and cli.poll() is None:
+                if _read_last_step(steps_log) < threshold:
+                    time.sleep(0.05)
+                    continue
+                pids = sorted((f for f in os.listdir(marker)
+                               if f.startswith("pid_r")),
+                              key=lambda s: int(s[5:]))
+                if pids:
+                    try:
+                        cand = int(open(os.path.join(
+                            marker, pids[-1])).read())
+                        if cand not in {p["pid"] for p in killed}:
+                            os.kill(cand, 0)
+                            pid = cand
+                            break
+                    except (OSError, ValueError):
+                        pass
+                time.sleep(0.1)
+            if pid is None:
+                break
+            try:
+                os.kill(pid, signal.SIGKILL)
+                killed.append({"t": round(time.monotonic() - t_start, 1),
+                               "at_step": _read_last_step(steps_log),
+                               "pid": pid})
+            except OSError:
+                pass
+        report["kills"] = killed
+
+        # ---- SIGKILL the master after the burst; pre-kill history must
+        # survive the journal replay as an identical prefix
+        from .agent.master_client import MasterClient
+
+        mc = MasterClient(addr, node_id=9999)
+        history_before: list = []
+        h_deadline = time.monotonic() + 30.0
+        while time.monotonic() < h_deadline and not history_before:
+            try:
+                history_before = mc.get_policy_history()
+            except Exception:  # noqa: BLE001
+                pass
+            if not history_before:
+                time.sleep(0.25)
+        master.kill()  # SIGKILL — replay must come from the journal
+        master.wait(timeout=10)
+        time.sleep(1.0)
+        master = spawn_master()
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and not addr_connectable(addr):
+            time.sleep(0.05)
+
+        try:
+            out, _ = cli.communicate(
+                timeout=max(10.0, t_start + timeout - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            cli.kill()
+            out, _ = cli.communicate()
+
+        history_after: list = []
+        try:
+            history_after = mc.get_policy_history()
+        except Exception:  # noqa: BLE001
+            pass
+
+        # ------------------------------------------------------ invariants
+        report["cli_rc"] = cli.returncode
+        report["completed"] = os.path.exists(os.path.join(marker, "done"))
+        report["worker_generations"] = sum(
+            1 for f in os.listdir(marker) if f.startswith("pid_r"))
+        executed = 0
+        try:
+            with open(steps_log) as f:
+                executed = sum(1 for _ in f)
+        except OSError:
+            pass
+        report["executed_steps"] = executed
+        adaptive_goodput = (round(total_steps / executed, 4)
+                            if executed >= total_steps else 0.0)
+        report["goodput"] = adaptive_goodput
+
+        ledgers = []
+        for name in os.listdir(marker):
+            if not name.startswith("ledger_r") or name.endswith(".tmp"):
+                continue
+            try:
+                with open(os.path.join(marker, name)) as f:
+                    ledgers.append(json.load(f))
+            except (OSError, ValueError):
+                pass
+        ledgers.sort(key=lambda t: t.get("restart", 0))
+        agg: Dict[str, float] = {}
+        for t in ledgers:
+            for k, v in t.get("states", {}).items():
+                agg[k] = agg.get(k, 0.0) + float(v)
+        report["ledger"] = {
+            "states": {k: round(v, 3) for k, v in sorted(agg.items())},
+            "generations": len(ledgers)}
+        report["goodput_ledger"] = _ledger_goodput(agg)
+        report["warm"] = {
+            "start_misses": sum(t.get("start_misses", 0)
+                                for t in ledgers),
+            "start_hits": sum(t.get("start_hits", 0) for t in ledgers),
+            "kchange_misses": sum(t.get("kchange_misses", 0)
+                                  for t in ledgers),
+            "kchange_hits": sum(t.get("kchange_hits", 0)
+                                for t in ledgers),
+            "kchanges": [c for t in ledgers
+                         for c in t.get("kchanges", [])]}
+
+        decisions = []
+        try:
+            with open(os.path.join(marker, "decisions.log")) as f:
+                for ln in f:
+                    decisions.append(json.loads(ln))
+        except (OSError, ValueError):
+            pass
+        report["decisions_applied"] = decisions
+        intervals = [d["interval"] for d in decisions if d["interval"] > 0]
+        tightened = bool(len(intervals) >= 2
+                         and min(intervals[1:]) < intervals[0])
+        protected = any(d.get("replicas", 0) >= 2
+                        and d.get("route") == "warm" for d in decisions)
+
+        def _did(d):
+            if isinstance(d, dict):
+                return int(d.get("decision_id", 0))
+            return int(getattr(d, "decision_id", 0) or 0)
+
+        ids_before = [_did(d) for d in history_before]
+        ids_after = [_did(d) for d in history_after]
+        report["history"] = {"before_kill": ids_before,
+                             "after_replay": ids_after}
+        prefix_ok = bool(ids_before
+                         and ids_after[:len(ids_before)] == ids_before)
+        return report
+    finally:
+        if master.poll() is None:
+            master.terminate()
+            try:
+                master.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                master.kill()
+        if cli is not None and cli.poll() is None:
+            cli.kill()
+        # decision log reconstructable from the JOURNAL ALONE: load the
+        # snapshot + frames offline (master stopped) and compare ids
+        journal_ids: list = []
+        try:
+            from .master.journal import MasterJournal
+
+            snap, entries = MasterJournal(journal_dir, fsync=False).load()
+            rebuilt = list((snap or {}).get("policy") or [])
+            rebuilt += [e["data"]["decision"] for e in entries
+                        if e.get("kind") == "policy"]
+            journal_ids = sorted({
+                int(d["decision_id"] if isinstance(d, dict)
+                    else d.decision_id) for d in rebuilt})
+        except Exception:  # noqa: BLE001
+            logger.warning("journal reconstruction failed", exc_info=True)
+        report["journal_decision_ids"] = journal_ids
+        ids_after = report.get("history", {}).get("after_replay", [])
+        report["journal_matches_history"] = bool(
+            ids_after and journal_ids
+            and set(ids_after).issubset(set(journal_ids)))
+        baseline_ok = bool(
+            report["baseline"]["completed"]
+            and report["baseline"]["cli_rc"] == 0
+            and report["baseline_kills_landed"] == kills)
+        report["ok"] = bool(
+            baseline_ok
+            and report.get("completed") and report.get("cli_rc") == 0
+            and len(report.get("kills", [])) == kills
+            # the gated metric is LEDGER-derived (the runtime's own
+            # attribution), with step accounting as a cross-check
+            and report.get("goodput_ledger", 0.0)
+            >= report["baseline"]["goodput_ledger"] + margin
+            and report.get("goodput", 0.0)
+            >= report["baseline"]["goodput"] + margin
+            and report.get("goodput", 0.0) >= floor
+            and len(report.get("decisions_applied", [])) >= 2
+            and tightened and protected
+            and report.get("warm", {}).get("kchange_hits", 0) >= 1
+            and report.get("warm", {}).get("kchange_misses", 1) == 0
+            and report.get("warm", {}).get("start_misses", 1) == 0
+            and prefix_ok and report["journal_matches_history"])
+        report["adaptation"] = {"tightened": tightened,
+                                "protected": protected,
+                                "history_prefix_preserved": prefix_ok}
+        if report["ok"]:
+            import shutil
+
+            shutil.rmtree(work, ignore_errors=True)
+            shutil.rmtree(cache, ignore_errors=True)
+        else:
+            report["cli_tail"] = (out or "")[-3000:]
+            report["workdir"] = work
 
 
 # ------------------------------------------------------------- ckpt corrupt
@@ -1330,13 +1945,26 @@ SCENARIOS = {"pod-kill": pod_kill, "straggler": straggler,
              "preempt": preempt, "preempt-table": preempt_table,
              "preempt-warm": preempt_warm,
              "preempt-fused": preempt_fused,
+             "preempt-adaptive": preempt_adaptive,
              "ckpt-corrupt": ckpt_corrupt,
              "master-kill": master_kill}
 
 
 def main(argv=None):
     argv = argv if argv is not None else sys.argv[1:]
-    names = argv or list(SCENARIOS)
+    # --policy-prior PATH seeds preempt-adaptive from a persisted
+    # preempt-table curve instead of the built-in drill-scale prior
+    prior = ""
+    filtered = []
+    it = iter(argv)
+    for a in it:
+        if a == "--policy-prior":
+            prior = next(it, "")
+        elif a.startswith("--policy-prior="):
+            prior = a.split("=", 1)[1]
+        else:
+            filtered.append(a)
+    names = filtered or list(SCENARIOS)
     ok = True
     for name in names:
         fn = SCENARIOS.get(name)
@@ -1344,7 +1972,8 @@ def main(argv=None):
             print(f"unknown scenario {name!r}; have {list(SCENARIOS)}",
                   file=sys.stderr)
             return 2
-        report = fn()
+        report = (fn(policy_prior=prior)
+                  if name == "preempt-adaptive" and prior else fn())
         print(json.dumps(report))
         ok = ok and report.get("ok", False)
     return 0 if ok else 1

@@ -55,6 +55,10 @@ class FlashCheckpointer:
             standalone=standalone, wire_dtype=wire_dtype,
             replica_fetch=replica_fetch)
         self.checkpoint_dir = checkpoint_dir
+        # optional CkptReplicaManager attachment so adaptive-policy
+        # replica-count changes have somewhere to land (the agent owns the
+        # ring; standalone runs may attach their own)
+        self.replica_manager = None
 
     @property
     def last_restore_report(self) -> Dict:
@@ -101,6 +105,25 @@ class FlashCheckpointer:
         if flat is None:
             return None
         return restore_pytree(template, flat)
+
+    # ------------------------------------------------- adaptive-policy knobs
+
+    def set_preferred_tier(self, tier: str):
+        """Restore-route hint from the policy engine (brain/policy.py):
+        "" default verified chain, "shm"/"replica"/"storage" prefer that
+        tier (the engine only ever SKIPS hot tiers — every tier stays
+        digest-verified).  Effective on the next load."""
+        if tier not in ("", "shm", "replica", "storage"):
+            raise ValueError(f"unknown restore tier {tier!r}")
+        if tier != self.engine.preferred_tier:
+            logger.info("preferred restore tier -> %r", tier or "auto")
+            self.engine.preferred_tier = tier
+
+    def set_replica_count(self, count: int):
+        """Forward a policy replica-count change to the attached ring
+        manager (no-op without one); effective on the next backup."""
+        if count >= 0 and self.replica_manager is not None:
+            self.replica_manager.set_replica_count(count)
 
     def last_step(self) -> int:
         return self.engine.latest_step()

@@ -129,6 +129,13 @@ class CheckpointEngine:
         # report of the last load(): which tier/generation served, every
         # fallback taken and why, whether self-heal re-staged shm
         self.last_restore: Dict = {}
+        # adaptive-policy restore hint (brain/policy.py): "" keeps the
+        # default verified chain shm → replica → storage; "replica" skips
+        # the local shm fast path (policy judged it likely stale/dead);
+        # "storage" forces the authoritative read.  Every tier stays
+        # digest-verified — the hint only SKIPS hot tiers, it never adds
+        # an unverified path.
+        self.preferred_tier = ""
 
     def _stage_locked(self, state: Any, step: int, extra: Dict):
         acquired = False
@@ -379,12 +386,18 @@ class CheckpointEngine:
         path = path or self.checkpoint_dir
         report: Dict = {"tier": "none", "step": -1, "fallbacks": [],
                         "healed": False}
+        preferred = self.preferred_tier
+        if preferred:
+            report["preferred"] = preferred
         self.last_restore = report
 
         stale_shm = None  # verified shm OLDER than the storage tracker:
         # kept as a candidate in case the newer storage gens are corrupt
-        with tspans.span("ckpt:restore:shm"), led.window("restore_shm"):
-            flat, shm_step, reason = self._load_verified_shm(path, step)
+        flat, shm_step, reason = None, -1, None
+        if preferred not in ("replica", "storage"):
+            with tspans.span("ckpt:restore:shm"), \
+                    led.window("restore_shm"):
+                flat, shm_step, reason = self._load_verified_shm(path, step)
         if flat is not None:
             if step is not None or shm_step >= read_last_step(
                     path, self.storage):
@@ -400,7 +413,8 @@ class CheckpointEngine:
         # replica tier: pull my segment from a peer holder into shm
         # (replica.py digest-checks the blob before it touches the
         # segment), then re-verify end to end
-        if stale_shm is None and self.replica_fetch is not None:
+        if stale_shm is None and self.replica_fetch is not None and \
+                preferred != "storage":
             with tspans.span("ckpt:restore:replica"), \
                     led.window("restore_replica"):
                 try:

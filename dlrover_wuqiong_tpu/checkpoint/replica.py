@@ -167,6 +167,15 @@ class CkptReplicaManager:
     def has_local_segment(self) -> bool:
         return self._shm.load_header() is not None
 
+    def set_replica_count(self, count: int):
+        """Adaptive-policy knob (brain/policy.py): effective on the NEXT
+        backup() — in-flight transfers finish at the old fan-out."""
+        count = max(0, min(int(count), max(0, len(self.peers) - 1)))
+        if count != self.replica_count:
+            logger.info("replica count %d -> %d", self.replica_count,
+                        count)
+            self.replica_count = count
+
     # ---------------------------------------------------------------- backup
 
     def _segment_bytes(self) -> Optional[Tuple[int, bytes]]:

@@ -245,6 +245,11 @@ class GoodputLedgerReport:
     states: Dict[str, float] = field(default_factory=dict)
     other_s: float = 0.0
     goodput_fraction: float = 0.0
+    # send-time wall-clock stamp (cross-process — time.time()): the
+    # degraded-mode buffer drains AFTER the frame that reconnected, so
+    # without it a stale buffered snapshot would overwrite the fresh one
+    # on the new master (latest-SENT must win, not latest-arrived)
+    sent_at: float = 0.0
 
 
 @message
@@ -360,6 +365,64 @@ class BrainJobMetricsRequest:
 @message
 class BrainJobMetricsResponse:
     samples: str = ""  # JSON list of usage samples
+
+
+# ---------------------------------------------------------------- adaptive policy
+
+
+@message
+class PolicyDecision:
+    """One adaptive fault-tolerance decision (brain/policy.py).
+
+    Four knobs per the Chameleon/PHOENIX loop: checkpoint cadence,
+    replica count, fused-K, and recovery route/tier.  ADD-ONLY schema
+    (tests/test_telemetry.py pins the field set).  ``issued_at`` is a
+    persisted cross-process timestamp, hence wall clock.
+    """
+
+    decision_id: int = 0
+    ckpt_interval_steps: int = 0   # 0 = no change
+    replica_count: int = -1        # -1 = no change
+    fused_steps: int = 0           # 0 = no change
+    recovery_route: str = ""       # "" | "warm" | "cold"
+    preferred_tier: str = ""       # "" | "shm" | "replica" | "storage"
+    preempt_rate_per_hr: float = 0.0
+    reason: str = ""
+    issued_at: float = 0.0
+
+
+@message
+class PolicyDecisionReport:
+    """Agent/operator-submitted decision (journaled + idem, like KV adds)."""
+
+    node_id: int = -1
+    decision: PolicyDecision = field(default_factory=PolicyDecision)
+
+
+@message
+class PolicyDecisionAck:
+    decision_id: int = 0
+    applied: bool = True
+    reason: str = ""
+
+
+@message
+class PolicyStateRequest:
+    """Pull the current (latest) decision for this job."""
+
+    node_id: int = -1
+
+
+@message
+class PolicyHistoryRequest:
+    """Pull the full decision history (JSON list, journal-backed)."""
+
+    node_id: int = -1
+
+
+@message
+class PolicyHistory:
+    content: str = ""  # JSON list of decision dicts, oldest first
 
 
 # ---------------------------------------------------------------- diagnosis

@@ -32,11 +32,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--poll-interval", type=float, default=5.0)
     p.add_argument("--max-seconds", type=float, default=None,
                    help="abort the job after this much wall clock")
+    p.add_argument("--policy", action="store_true",
+                   help="run the adaptive fault-tolerance policy engine "
+                        "(brain/policy.py) in the master loop")
+    p.add_argument("--policy-prior", default="",
+                   help="preempt_table.json from `chaos preempt-table` to "
+                        "seed the policy engine's cost model")
     args = p.parse_args(argv)
     return run_master_forever(
         args.port, args.min_nodes, args.max_nodes, node_unit=args.node_unit,
         journal_dir=args.journal_dir or None,
-        poll_interval=args.poll_interval, max_seconds=args.max_seconds)
+        poll_interval=args.poll_interval, max_seconds=args.max_seconds,
+        policy=args.policy, policy_prior=args.policy_prior)
 
 
 if __name__ == "__main__":

@@ -43,7 +43,8 @@ class JobMaster:
                  max_nodes: int = 1, node_unit: int = 1,
                  scaler: Optional[Scaler] = None,
                  job_manager: Optional[JobManager] = None,
-                 journal_dir: Optional[str] = None):
+                 journal_dir: Optional[str] = None,
+                 policy_engine=None):
         ctx = get_context()
         self.speed_monitor = SpeedMonitor(ctx.train_speed_record_num)
         self.job_manager = job_manager or LocalJobManager(scaler=scaler)
@@ -91,6 +92,14 @@ class JobMaster:
         self._node_events: list = []
         self._goodput: Dict[int, msg.GoodputLedgerReport] = {}
         self._paral_config = msg.ParallelConfig()
+        # ---------------------------------------------- adaptive policy
+        # brain/policy.py closed loop: decisions live here (journaled as
+        # "policy" frames BEFORE they become visible over the get verbs)
+        # so the decision log replays identically across a master restart
+        # even though the engine's rate estimator restarts cold.
+        self.policy_engine = policy_engine
+        self._policy_decisions: list = []
+        self._policy_seq = 0
         # ------------------------------------------------- fault tolerance
         # journal + fencing epoch (master/journal.py): with a journal dir,
         # this master replays any prior incarnation's control-plane state
@@ -199,6 +208,8 @@ class JobMaster:
             self._paral_config = state["paral"]
         if state.get("idem"):
             self.idem_cache.restore_state(state["idem"])
+        for decision in state.get("policy") or []:
+            self._apply_policy(decision)
 
     def _apply_entry(self, kind: str, data: Dict):
         data = dict(data)
@@ -249,6 +260,8 @@ class JobMaster:
                 data.get("accelerator_num", 0)
         elif kind == "paral":
             self._paral_config = data["config"]
+        elif kind == "policy":
+            self._apply_policy(data["decision"])
         elif kind == "shard_ckpt":
             self.task_manager.restore_dataset_from_checkpoint(
                 data["content"])
@@ -269,6 +282,7 @@ class JobMaster:
                       for n in self.job_manager.all_nodes()],
             "paral": self._paral_config,
             "idem": self.idem_cache.export_state(),
+            "policy": list(self._policy_decisions),
         }
 
     def snapshot_journal(self):
@@ -321,7 +335,16 @@ class JobMaster:
 
     def collect_goodput(self, report: msg.GoodputLedgerReport):
         """Latest-wins per-node ledger snapshot (reports are cumulative,
-        so drops/replays over the BUFFERED verb class are harmless)."""
+        so drops/replays over the BUFFERED verb class are harmless).
+
+        Latest means latest-SENT, not latest-arrived: the client's
+        degraded buffer drains AFTER the frame that re-established the
+        connection, so buffered (older) snapshots arrive last across a
+        master restart and must not overwrite the fresh one."""
+        prev = self._goodput.get(report.node_id)
+        if prev is not None and getattr(prev, "sent_at", 0.0) > \
+                getattr(report, "sent_at", 0.0) > 0.0:
+            return
         self._goodput[report.node_id] = report
         for state, secs in report.states.items():
             self.metric_collector.reg.gauge(
@@ -351,6 +374,74 @@ class JobMaster:
             goodput_fraction=(productive / total) if total > 0 else 0.0,
             nodes=len(self._goodput))
 
+    # ------------------------------------------------------ adaptive policy
+
+    def _apply_policy(self, decision: msg.PolicyDecision):
+        """Make a (journaled/replayed) decision visible to the get verbs."""
+        self._policy_decisions.append(decision)
+        if len(self._policy_decisions) > 1000:
+            self._policy_decisions = self._policy_decisions[-500:]
+        self._policy_seq = max(self._policy_seq, decision.decision_id)
+        if self.policy_engine is not None:
+            self.policy_engine.note_emitted(decision)
+
+    def admit_policy_decision(self, decision: msg.PolicyDecision
+                              ) -> msg.PolicyDecision:
+        """Externally submitted decision (servicer journals it + idem)."""
+        if decision.decision_id <= self._policy_seq:
+            decision.decision_id = self._policy_seq + 1
+        if not decision.issued_at:
+            decision.issued_at = time.time()
+        self._apply_policy(decision)
+        return decision
+
+    def policy_current(self) -> msg.PolicyDecision:
+        if self._policy_decisions:
+            return self._policy_decisions[-1]
+        return msg.PolicyDecision()
+
+    def policy_history_json(self) -> str:
+        import dataclasses
+        import json
+
+        return json.dumps([dataclasses.asdict(d)
+                           for d in self._policy_decisions])
+
+    def note_policy_failure(self, node_id: int):
+        """Feed the rate estimator from the NodeFailure/dead-node paths
+        (the same events the journal records as "recover" frames)."""
+        if self.policy_engine is not None:
+            try:
+                self.policy_engine.record_failure()
+            except Exception:  # noqa: BLE001 — telemetry must never kill
+                logger.exception("policy failure-event record failed")
+
+    def _policy_tick(self):
+        """One closed-loop evaluation: journal BEFORE visibility."""
+        eng = self.policy_engine
+        if eng is None:
+            return
+        try:
+            s = self.goodput_summary()
+            eng.observe_goodput({
+                "goodput_fraction": s.goodput_fraction,
+                "wall_s": s.wall_s, "nodes": s.nodes})
+            decision = eng.maybe_decide()
+            if decision is None:
+                return
+            decision.decision_id = self._policy_seq + 1
+            if self.journal is not None:
+                self.journal.append("policy", {"decision": decision})
+            self._apply_policy(decision)
+            logger.info(
+                "policy decision #%d: ckpt=%d replicas=%d fused=%d "
+                "route=%s tier=%s (%s)", decision.decision_id,
+                decision.ckpt_interval_steps, decision.replica_count,
+                decision.fused_steps, decision.recovery_route,
+                decision.preferred_tier, decision.reason)
+        except Exception:  # noqa: BLE001 — policy must never kill the loop
+            logger.exception("policy tick failed")
+
     # --------------------------------------------------------------- run loop
 
     def run(self, poll_interval: float = 5.0,
@@ -364,6 +455,7 @@ class JobMaster:
         start = time.monotonic()
         while not self._stopped.wait(poll_interval):
             self._collect_metrics()
+            self._policy_tick()
             if self.journal is not None and \
                     self.journal.entries_since_snapshot >= \
                     self.journal.snapshot_every:
@@ -376,6 +468,7 @@ class JobMaster:
             for node in self.job_manager.get_dead_nodes():
                 logger.warning("node %s heartbeat timeout — marking failed",
                                node.id)
+                self.note_policy_failure(node.id)
                 from ..common.constants import NodeEventType, NodeStatus
                 from ..common.node import Node, NodeEvent
                 dead = Node(node.type, node.id, rank_index=node.rank_index)
@@ -427,10 +520,18 @@ def run_master_forever(port: int, min_nodes: int, max_nodes: int,
                        node_unit: int = 1,
                        journal_dir: Optional[str] = None,
                        poll_interval: float = 5.0,
-                       max_seconds: Optional[float] = None):
+                       max_seconds: Optional[float] = None,
+                       policy: bool = False,
+                       policy_prior: str = ""):
     """Entry for a standalone master process (parity master/main.py:63)."""
+    engine = None
+    if policy:
+        from ..brain.policy import PolicyEngine
+
+        engine = PolicyEngine(prior_path=policy_prior)
     master = JobMaster(port=port, min_nodes=min_nodes, max_nodes=max_nodes,
-                       node_unit=node_unit, journal_dir=journal_dir)
+                       node_unit=node_unit, journal_dir=journal_dir,
+                       policy_engine=engine)
     master.prepare()
     try:
         return master.run(poll_interval=poll_interval,

@@ -19,6 +19,7 @@ from typing import Any, Optional
 
 from ..common import messages as msg
 from ..common.comm import RpcServer
+from ..common.global_context import get_context
 from ..common.log import get_logger
 from ..common.node import Node, NodeEvent
 from ..common.constants import NodeEventType, NodeStatus
@@ -157,6 +158,12 @@ class MasterServicer:
         if isinstance(payload, msg.GoodputQuery):
             return m.goodput_summary()
 
+        if isinstance(payload, msg.PolicyStateRequest):
+            return m.policy_current()
+
+        if isinstance(payload, msg.PolicyHistoryRequest):
+            return msg.PolicyHistory(content=m.policy_history_json())
+
         raise ValueError(f"unknown get message: {type(payload).__name__}")
 
     def _report(self, node_id: int, node_type: str, payload: Any,
@@ -264,15 +271,20 @@ class MasterServicer:
             m.task_manager.recover_tasks(payload.node_id)
             for rdzv in m.rdzv_managers.values():
                 rdzv.remove_alive_node(payload.node_id)
+            m.note_policy_failure(payload.node_id)
             # journal the shard recovery (not the classification — error
             # history is advisory): a replayed master must not keep the
             # dead node's shards parked in `doing` forever
             self._journal("recover", {"node_id": payload.node_id})
             # tell the agent whether process restarts can fix this class —
             # a user-code error restarts into the same crash every time,
-            # and a class repeating across restarts is equally unfixable
+            # and a class repeating across restarts is equally unfixable.
+            # relaunch_always overrides, same as _should_relaunch: on
+            # preemption-heavy pools a SIGKILL storm classifies as
+            # host_oom (exit_code=137 is ambiguous) and would otherwise
+            # strand the job after 3 kills
             repeated = m.job_manager.error_monitor.repeated_class(rank)
-            if repeated is not None:
+            if repeated is not None and not get_context().relaunch_always:
                 relaunchable = False
                 why = f"error class {repeated!r} repeats across restarts"
             else:
@@ -316,6 +328,18 @@ class MasterServicer:
             # journal frame; a master restart just waits for the next one
             m.collect_goodput(payload)
             return msg.OkResponse()
+
+        if isinstance(payload, msg.PolicyDecisionReport):
+            decision = m.admit_policy_decision(payload.decision)
+            resp = msg.PolicyDecisionAck(decision_id=decision.decision_id,
+                                         applied=True)
+            # decisions change durable protection knobs: the frame must
+            # outlive this master before the ack leaves, and a retry
+            # crossing a restart must replay the ack, not re-admit a
+            # duplicate decision_id
+            self._journal("policy", {"decision": decision},
+                          idem=idem, resp=resp)
+            return resp
 
         if isinstance(payload, msg.DiagnosisReport):
             return m.diagnosis_manager.collect_report(payload)

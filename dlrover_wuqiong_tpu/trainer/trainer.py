@@ -95,6 +95,12 @@ class TrainingArgs:
     # fusion boundaries when fused — so the agent's save-on-failure
     # persists the last boundary; 0 = off
     flash_stage_steps: int = 0
+    # poll the master's adaptive fault-tolerance decision (brain/policy.py)
+    # every N steps — at fusion boundaries only; 0 = off.  Applies ckpt
+    # cadence / restore-tier / replica knobs immediately; a fused-K change
+    # first pre-compiles through the warm pool (K is part of the compile
+    # cache key) and cuts over only once the entry is ready.
+    policy_steps: int = 0
 
 
 class Trainer:
@@ -162,6 +168,15 @@ class Trainer:
             if args.tune_config_steps and os.getenv(ConfigPath.ENV_PARAL_CONFIG)
             else None)
 
+        # adaptive-policy state: last decision id applied (master ids are
+        # monotonic — replays/duplicates after a reconnect are skipped),
+        # a fused-K change parked until its warm-pool entry is ready, and
+        # the applied-decision log (tests + post-mortem)
+        self._policy_last_id = 0
+        self._policy_pending_k: Optional[int] = None
+        self._warm_pool = None
+        self.policy_applied: list = []
+
         # device-queue liveness probe → master hang localization
         self._prober = None
         if args.probe_interval > 0 and self.ctx.mc is not None:
@@ -209,6 +224,97 @@ class Trainer:
         if cfg.get("mesh_shape"):
             logger.info("master proposes mesh %s (applies on next restart)",
                         cfg["mesh_shape"])
+
+    # ------------------------------------------------- adaptive policy
+
+    def _poll_policy(self) -> None:
+        """Fetch the master's current PolicyDecision (polling verb — a
+        dead master degrades to the last applied knobs, never an error)
+        and apply it if it is new."""
+        try:
+            d = self.ctx.mc.get_policy_decision()
+        except Exception:  # noqa: BLE001 — degraded mode keeps training
+            return
+        did = int(getattr(d, "decision_id", 0) or 0)
+        if did <= self._policy_last_id:
+            return
+        self._policy_last_id = did
+        self._apply_policy_decision(d)
+
+    def _apply_policy_decision(self, d) -> None:
+        """Apply one PolicyDecision's knobs.  Cadence/tier/replica apply
+        immediately (next boundary / next backup / next load); a fused-K
+        request is PARKED in _policy_pending_k — the loop cuts over only
+        after _prewarm_fused_k confirms a ready warm-pool entry, because
+        K changes the HLO and a cold mid-run compile would cost more than
+        any cadence win."""
+        applied: Dict[str, Any] = {"decision_id": d.decision_id}
+        k_active = int(getattr(self, "_fused_k_active", 0) or 1)
+        interval = int(getattr(d, "ckpt_interval_steps", 0) or 0)
+        if interval > 0:
+            if k_active > 1 and interval % k_active:
+                # boundary-reachable: round UP to a fusion multiple so the
+                # cadence the policy paid for is never silently skipped
+                interval = ((interval + k_active - 1) // k_active) * k_active
+            if interval != self.args.save_steps:
+                logger.info("policy #%d: ckpt cadence %d -> %d steps",
+                            d.decision_id, self.args.save_steps, interval)
+                self.args.save_steps = interval
+            applied["ckpt_interval_steps"] = interval
+        tier = getattr(d, "preferred_tier", "") or ""
+        if tier:
+            try:
+                self.ckpt.set_preferred_tier(tier)
+                applied["preferred_tier"] = tier
+            except ValueError as e:
+                logger.warning("policy #%d: %s", d.decision_id, e)
+        replicas = int(getattr(d, "replica_count", -1))
+        if replicas >= 0:
+            self.ckpt.set_replica_count(replicas)
+            applied["replica_count"] = replicas
+        k_req = int(getattr(d, "fused_steps", 0) or 0)
+        if k_req > 0 and k_req != k_active:
+            cad = self._hook_cadence()
+            if k_req > 1 and cad and cad % k_req:
+                logger.warning(
+                    "policy #%d: fused_steps=%d does not divide the hook "
+                    "cadence gcd %d — keeping K=%d", d.decision_id, k_req,
+                    cad, k_active)
+            elif getattr(self.res, "_fused_factory", None) is None \
+                    and k_req > 1:
+                logger.warning("policy #%d: no fused driver for this "
+                               "strategy — keeping K=%d", d.decision_id,
+                               k_active)
+            else:
+                self._policy_pending_k = k_req
+                applied["fused_steps_requested"] = k_req
+        self.policy_applied.append(applied)
+
+    def _prewarm_fused_k(self, k: int) -> bool:
+        """True when switching the fused driver to K will hit the compile
+        cache.  Without a warm-pool cache dir there is nothing to consult
+        (tests / standalone runs) — allow the cutover.  Otherwise derive
+        the target spec from the published current spec at the new K:
+        ready entry → go; else kick an async warm compile and stay at the
+        current K until a later boundary finds it ready."""
+        cache_dir = os.getenv("DWT_COMPILE_CACHE_DIR", "")
+        if not cache_dir:
+            return True
+        from ..auto.warm_pool import WarmPool, load_current_spec
+
+        if self._warm_pool is None:
+            self._warm_pool = WarmPool(cache_dir)
+        spec = load_current_spec(cache_dir)
+        if spec is None:
+            return True  # nothing published: no warm entry to wait for
+        if int(getattr(spec, "fused_steps", 1)) != k:
+            spec = dataclasses.replace(spec, fused_steps=k)
+        if self._warm_pool._ready_entry_for(spec.spec_key()) is not None:
+            return True
+        self._warm_pool.warm_async(spec)
+        logger.info("policy: warming fused_steps=%d in the pool — cutover "
+                    "deferred until the entry is ready", k)
+        return False
 
     # ------------------------------------------------------------- schedule
 
@@ -269,6 +375,7 @@ class Trainer:
                   a.eval_steps if self.eval_data is not None else 0,
                   a.tune_config_steps if self._tune_listener is not None
                   else 0,
+                  a.policy_steps if self.ctx.mc is not None else 0,
                   a.flash_stage_steps):
             if c:
                 cad = math.gcd(cad, int(c))
@@ -391,6 +498,21 @@ class Trainer:
                     # two unfused steps measured (the first compiles):
                     # decide K, then fuse the rest of the run
                     fused_k = self._autotune_fused_k(step_time_s)
+                if self._policy_pending_k is not None and \
+                        fused_k is not None:
+                    # fusion-boundary K cutover: only once the warm pool
+                    # holds a ready entry at the new K (never a cold
+                    # compile mid-run); the stager rebuilds below at the
+                    # new width, K=1 falls back to the unfused path
+                    if self._policy_pending_k == fused_k:
+                        self._policy_pending_k = None
+                    elif self._prewarm_fused_k(self._policy_pending_k):
+                        logger.info("policy: fused_steps %d -> %d at "
+                                    "boundary %d", fused_k,
+                                    self._policy_pending_k, step)
+                        fused_k = self._policy_pending_k
+                        self._policy_pending_k = None
+                        stager = None
                 self._fused_k_active = fused_k or 0
                 if fused_k is not None and fused_k > 1 and stager is None:
                     from ..data.elastic_dataset import FusedBatchStager
@@ -413,6 +535,9 @@ class Trainer:
                     tuned = self._tune_listener.poll()
                     if tuned:
                         self._apply_tuned_config(tuned)
+                if a.policy_steps and self.ctx.mc is not None and \
+                        s0 % a.policy_steps == 0:
+                    self._poll_policy()
                 prof_before = self.profiler.last_profile
                 t_blk0 = time.monotonic()
                 with self.profiler.step(s0):

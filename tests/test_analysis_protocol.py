@@ -115,6 +115,63 @@ class TestJournalBeforeAck:
         assert found == []
 
 
+class TestPolicyVerbs:
+    """PolicyDecisionReport sits in JOURNALED_VERBS + IDEM_VERBS: an
+    adaptive decision that vanishes across a master restart would leave
+    trainers on knobs the replayed master never heard of."""
+
+    def test_policy_ack_without_journal_flagged(self, tmp_path):
+        found = _scan(tmp_path, "servicer.py", _SERVICER_PREAMBLE + """\
+        def _report(self, node_id, payload, idem=None):
+            if isinstance(payload, msg.PolicyDecisionReport):
+                decision = self.m.admit_policy_decision(payload.decision)
+                return msg.PolicyDecisionAck(
+                    decision_id=decision.decision_id)
+            return None
+""")
+        assert [f.checker for f in found] == ["journal-before-ack"]
+        assert "PolicyDecisionReport" in found[0].message
+
+    def test_policy_journal_without_idem_flagged(self, tmp_path):
+        found = _scan(tmp_path, "servicer.py", _SERVICER_PREAMBLE + """\
+        def _report(self, node_id, payload, idem=None):
+            if isinstance(payload, msg.PolicyDecisionReport):
+                decision = self.m.admit_policy_decision(payload.decision)
+                resp = msg.PolicyDecisionAck(
+                    decision_id=decision.decision_id)
+                self._journal("policy", {"decision": decision})
+                return resp
+            return None
+""")
+        assert [f.checker for f in found] == ["idem-key-required"]
+        assert "PolicyDecisionReport" in found[0].message
+
+    def test_policy_journal_before_ack_with_idem_clean(self, tmp_path):
+        # the in-tree servicer shape: journal carries idem + resp in ONE
+        # frame (a separate frame could tear between them)
+        found = _scan(tmp_path, "servicer.py", _SERVICER_PREAMBLE + """\
+        def _report(self, node_id, payload, idem=None):
+            if isinstance(payload, msg.PolicyDecisionReport):
+                decision = self.m.admit_policy_decision(payload.decision)
+                resp = msg.PolicyDecisionAck(
+                    decision_id=decision.decision_id)
+                self._journal("policy", {"decision": decision},
+                              idem=idem, resp=resp)
+                return resp
+            return None
+""")
+        assert found == []
+
+    def test_policy_client_send_without_idem_flagged(self, tmp_path):
+        found = _scan(tmp_path, "client.py", """\
+            class Client:
+                def report_policy_decision(self, decision):
+                    req = msg.PolicyDecisionReport(decision=decision)
+                    return self._call_critical("report", req)
+        """)
+        assert [f.checker for f in found] == ["idem-key-required"]
+
+
 # ------------------------------------------------- idem-key-required
 
 

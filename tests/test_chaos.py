@@ -52,6 +52,30 @@ def test_ckpt_corrupt_zero_silent_restores():
     assert report["flight"]["ledger"]["restore_storage"] > 0
 
 
+def test_cli_policy_prior_flag(capsys, monkeypatch):
+    """`--policy-prior PATH` routes to preempt-adaptive ONLY (other
+    scenarios keep their zero-arg contract) and both `--policy-prior P`
+    and `--policy-prior=P` spellings parse."""
+    seen = {}
+
+    def fake_adaptive(policy_prior=""):
+        seen["prior"] = policy_prior
+        return {"scenario": "preempt-adaptive", "ok": True}
+
+    monkeypatch.setitem(chaos.SCENARIOS, "preempt-adaptive", fake_adaptive)
+    monkeypatch.setitem(chaos.SCENARIOS, "straggler",
+                        lambda: {"scenario": "straggler", "ok": True})
+    rc = chaos.main(["preempt-adaptive", "--policy-prior", "/tmp/p.json"])
+    assert rc == 0 and seen["prior"] == "/tmp/p.json"
+    rc = chaos.main(["preempt-adaptive", "--policy-prior=/x.json"])
+    assert rc == 0 and seen["prior"] == "/x.json"
+    # the flag must not leak into the scenario name list
+    rc = chaos.main(["straggler", "--policy-prior", "/tmp/p.json"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 3
+
+
 def test_cli_runs_all(capsys):
     rc = chaos.main(["straggler", "network-partition"])
     assert rc == 0
@@ -114,3 +138,61 @@ def test_preempt_fused_boundaries_keep_goodput():
     assert r["completed"], r
     assert r["wasted_steps"] > 10, r
     assert r["goodput"] < 0.95, r
+
+
+def test_preempt_table_persists_policy_prior(tmp_path, monkeypatch):
+    """The curve is the adaptive engine's offline prior: rows land
+    atomically in out_dir/policy/preempt_table.json and load_prior can
+    calibrate from the file as written (drills stubbed for speed)."""
+    def fake_preempt(**kw):
+        return {"goodput": 0.9 + kw["ckpt_interval"] / 1e4,
+                "wasted_steps": 3, "completed": True,
+                "kills": [{"gen": 1}, {"gen": 2}],
+                "downtime": {"restarts": 2}}
+
+    monkeypatch.setattr(chaos, "preempt", fake_preempt)
+    report = chaos.preempt_table(total_steps=10, dt=0.05, kills=2,
+                                 out_dir=str(tmp_path))
+    assert report["ok"], report
+    assert report["table_path"] == str(
+        tmp_path / "policy" / "preempt_table.json")
+    import json
+
+    with open(report["table_path"]) as f:
+        table = json.load(f)
+    assert table["dt"] == 0.05
+    assert [r["interval"] for r in table["rows"]] == \
+        [200, 50, 10, 50, 50, 50]
+    # no torn tmp file left behind by the atomic publish
+    assert sorted(p.name for p in (tmp_path / "policy").iterdir()) == \
+        ["preempt_table.json"]
+    from dlrover_wuqiong_tpu.brain.policy import load_prior
+
+    prior = load_prior(report["table_path"])
+    assert prior["step_time_s"] == 0.05
+    assert prior["ckpt_cost_s"] > 0
+
+
+@pytest.mark.slow  # tier-2: ~3-4 min closed-loop drill (two full runs +
+# warm-pool precompile + master SIGKILL); the pure policy parts are
+# tier-1 in test_policy.py and the journal replay in test_master_restart
+def test_preempt_adaptive_beats_static_baseline():
+    """Adaptive policy engine (ISSUE 9 acceptance): failure rate shifts
+    mid-run; the closed loop must beat the static-cadence baseline by
+    the checked-in margin, apply K changes only through the warm pool
+    (zero cold compiles), and the decision log must reconstruct from the
+    journal alone across a master SIGKILL."""
+    report = chaos.preempt_adaptive()
+    assert report["ok"], report
+    assert report["goodput_ledger"] >= \
+        report["baseline"]["goodput_ledger"] + report["margin"], report
+    assert report["goodput"] >= \
+        report["baseline"]["goodput"] + report["margin"], report
+    assert len(report["decisions_applied"]) >= 2, report
+    assert report["adaptation"]["tightened"], report
+    assert report["adaptation"]["protected"], report
+    # fused-K cutovers never hit a cold compile
+    assert report["warm"]["kchange_hits"] >= 1, report["warm"]
+    assert report["warm"]["kchange_misses"] == 0, report["warm"]
+    assert report["warm"]["start_misses"] == 0, report["warm"]
+    assert report["journal_matches_history"], report

@@ -554,3 +554,142 @@ class TestSubprocessMasterRestart:
             assert mc.degraded_stats()["reregistrations"] >= 0
         finally:
             sched.close()
+
+
+# ------------------------------- goodput snapshots across a master restart
+
+
+class TestGoodputAcrossRestart:
+    def test_buffered_drain_latest_sent_per_node_wins(self, tmp_path):
+        """Snapshots buffered through an outage drain AFTER the frame
+        that reconnected to the new master — the master must keep the
+        newest-SENT cumulative snapshot per node, not whichever frame
+        happened to arrive last."""
+        jd = str(tmp_path / "journal")
+        port = comm.find_free_port()
+        m1 = JobMaster(port=port, journal_dir=jd)
+        m1.prepare()
+        mc = MasterClient(f"127.0.0.1:{port}", node_id=0)
+
+        def snap(wall):
+            return {"wall_s": wall, "states": {"productive": wall * 0.8},
+                    "other_s": 0.0, "goodput_fraction": 0.8}
+
+        mc.report_goodput_ledger(snap(10.0))
+        assert m1.goodput_summary().wall_s == 10.0
+        m1._server.stop()  # noqa: SLF001 — crash, no snapshot
+        mc._client.close()  # noqa: SLF001 — the kill severs the socket too
+        # outage: two newer cumulative snapshots park in the buffer
+        mc.report_goodput_ledger(snap(20.0))
+        mc.report_goodput_ledger(snap(30.0))
+        assert mc.degraded_stats()["pending"] == 2
+        m2 = JobMaster(port=port, journal_dir=jd)
+        m2.prepare()
+        try:
+            assert m2.epoch == 2  # the restart was a real fencing bump
+            # reconnect frame lands first, THEN the buffer drains (the
+            # older frames arrive after the newer one)
+            mc.report_goodput_ledger(snap(40.0))
+            assert mc.degraded_stats()["pending"] == 0
+            s = m2.goodput_summary()
+            assert s.nodes == 1
+            assert s.wall_s == 40.0
+            assert s.states["productive"] == 40.0 * 0.8
+        finally:
+            m2.stop()
+
+    def test_unstamped_report_still_lands(self):
+        """Back-compat: a report without sent_at (old sender) must apply
+        — only a PROVABLY older stamp loses."""
+        from dlrover_wuqiong_tpu.common import messages as msg
+
+        m = JobMaster(port=0)
+        m.collect_goodput(msg.GoodputLedgerReport(
+            node_id=1, wall_s=5.0, sent_at=100.0))
+        m.collect_goodput(msg.GoodputLedgerReport(node_id=1, wall_s=7.0))
+        assert m.goodput_summary().wall_s == 7.0
+
+
+# --------------------------------- policy decisions across a master restart
+
+
+class TestPolicyAcrossRestart:
+    def test_decision_log_replays_from_journal_alone(self, tmp_path):
+        """brain/policy.py durability contract: every decision is
+        journaled before it becomes visible, so a successor master — even
+        one started WITHOUT a policy engine — serves the identical
+        history after replay."""
+        from dlrover_wuqiong_tpu.brain.policy import (
+            PolicyConfig,
+            PolicyEngine,
+        )
+
+        jd = str(tmp_path / "journal")
+        m1 = JobMaster(port=0, journal_dir=jd,
+                       policy_engine=PolicyEngine(PolicyConfig(tau_s=30.0)))
+        m1.prepare()
+        m1._policy_tick()  # noqa: SLF001 — quiet-regime decision #1
+        mc = _client_for(m1)
+        d1 = mc.get_policy_decision()
+        assert d1.decision_id == 1
+        assert d1.fused_steps == 4  # quiet: full ladder
+        # failure burst → the regime shifts, decision #2 fires
+        for _ in range(4):
+            m1.note_policy_failure(0)
+        m1._policy_tick()  # noqa: SLF001
+        hist1 = mc.get_policy_history()
+        assert [h["decision_id"] for h in hist1] == [1, 2]
+        assert hist1[1]["fused_steps"] == 1
+        assert hist1[1]["replica_count"] == 2
+        assert hist1[1]["ckpt_interval_steps"] < \
+            hist1[0]["ckpt_interval_steps"]
+        m1._server.stop()  # noqa: SLF001 — crash, no snapshot
+
+        m2 = JobMaster(port=0, journal_dir=jd)  # replay-only successor
+        m2.prepare()
+        try:
+            mc2 = _client_for(m2)
+            hist2 = mc2.get_policy_history()
+            assert [h["decision_id"] for h in hist2] == [1, 2]
+            assert hist2 == hist1  # byte-identical decisions, not just ids
+            assert mc2.get_policy_decision().decision_id == 2
+        finally:
+            m2.stop()
+
+    def test_reported_decision_idempotent_across_restart(self, tmp_path):
+        """An externally reported decision acked by master #1 and RETRIED
+        (same idem key) against replayed master #2 must replay the ack,
+        not admit a duplicate decision."""
+        from dlrover_wuqiong_tpu.common.messages import (
+            PolicyDecision,
+            PolicyDecisionReport,
+        )
+
+        jd = str(tmp_path / "journal")
+        m1 = JobMaster(port=0, journal_dir=jd)
+        m1.prepare()
+        mc = _client_for(m1)
+        idem = "node0:policy:1"
+        report = PolicyDecisionReport(
+            node_id=0, decision=PolicyDecision(ckpt_interval_steps=40,
+                                               fused_steps=1,
+                                               recovery_route="warm"))
+        ack = mc._client._call("report", report, idem=idem)  # noqa: SLF001
+        assert ack.applied and ack.decision_id == 1
+        m1._server.stop()  # noqa: SLF001
+
+        m2 = JobMaster(port=0, journal_dir=jd)
+        m2.prepare()
+        try:
+            mc2 = _client_for(m2)
+            replay = mc2._client._call(  # noqa: SLF001
+                "report", report, idem=idem)
+            assert replay.decision_id == 1  # the journaled ack, replayed
+            hist = mc2.get_policy_history()
+            assert [h["decision_id"] for h in hist] == [1]  # no duplicate
+            # a FRESH decision still advances the sequence
+            ack2 = mc2.report_policy_decision(
+                PolicyDecision(ckpt_interval_steps=80))
+            assert ack2.decision_id == 2
+        finally:
+            m2.stop()

@@ -1,0 +1,261 @@
+"""Adaptive fault-tolerance policy engine (brain/policy.py).
+
+The closed loop's pure parts, deterministically: the EWMA preemption
+estimator on an injected clock, the four knob algorithms at pinned
+regimes, offline-prior calibration (+ config overrides), and the engine's
+hysteresis contract.  The live loop (master tick → journal → trainer
+knob pickup) is covered by tests/test_master_restart.py and the
+`chaos preempt-adaptive` drill.
+"""
+
+import dataclasses
+import json
+import math
+
+import pytest
+
+from dlrover_wuqiong_tpu.brain.plugins import get_algorithm
+from dlrover_wuqiong_tpu.brain.policy import (
+    PolicyConfig,
+    PolicyEngine,
+    PreemptionRateEstimator,
+    load_prior,
+)
+from dlrover_wuqiong_tpu.common import messages as msg
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------- estimator
+
+
+class TestPreemptionRateEstimator:
+    def test_no_events_means_infinite_mtbf(self):
+        est = PreemptionRateEstimator(tau_s=60.0, clock=FakeClock())
+        assert est.rate_per_s() == 0.0
+        assert est.mtbf_s() == float("inf")
+
+    def test_rate_converges_and_decays(self):
+        clk = FakeClock()
+        est = PreemptionRateEstimator(tau_s=60.0, clock=clk)
+        # a burst of 3 failures in 2 seconds: weight ≈ 3, rate ≈ 3/tau
+        for t in (0.0, 1.0, 2.0):
+            clk.t = t
+            est.record()
+        rate = est.rate_per_s()
+        assert rate == pytest.approx(3.0 / 60.0, rel=0.05)
+        assert est.mtbf_s() == pytest.approx(20.0, rel=0.05)
+        # one tau later the weight has decayed by e
+        clk.t = 2.0 + 60.0
+        assert est.rate_per_s() == pytest.approx(rate / math.e, rel=0.05)
+        assert est.events == 3
+
+    def test_decay_is_deterministic_on_injected_clock(self):
+        def run():
+            clk = FakeClock()
+            est = PreemptionRateEstimator(tau_s=30.0, clock=clk)
+            for t in (5.0, 6.0, 40.0):
+                clk.t = t
+                est.record()
+            clk.t = 55.0
+            return est.rate_per_s()
+
+        assert run() == run()
+
+
+# ----------------------------------------------------------- knob algorithms
+
+
+class TestPolicyAlgorithms:
+    CFG = PolicyConfig()  # ckpt_cost=0.1s, step=0.05s, bounds [5, 500]
+
+    def _cfg(self, mtbf_s, replica_count=1):
+        return self.CFG.algo_cfg(mtbf_s, replica_count)
+
+    def test_registry_has_policy_algorithms(self):
+        from dlrover_wuqiong_tpu.brain.plugins import algorithms
+
+        assert set(algorithms()) >= {
+            "optimize_job_ckpt_interval", "optimize_job_fused_steps",
+            "optimize_job_replica_count", "optimize_job_recovery_route"}
+
+    def test_young_daly_interval(self):
+        f = get_algorithm("optimize_job_ckpt_interval")
+        # sqrt(2 * 0.1 * 20) = 2s → 40 steps at 0.05s/step
+        assert f([], [], self._cfg(20.0)) == 40
+        # quiet regime clamps at the max bound (never unbounded)
+        assert f([], [], self._cfg(float("inf"))) == 500
+        # brutal regime clamps at the min bound (never thrashing saves)
+        assert f([], [], self._cfg(1e-6)) == 5
+
+    def test_fused_ladder_descends_with_mtbf(self):
+        f = get_algorithm("optimize_job_fused_steps")
+        assert f([], [], self._cfg(1e9)) == 4      # >= 600s floor
+        assert f([], [], self._cfg(300.0)) == 2    # >= 120s floor
+        assert f([], [], self._cfg(20.0)) == 1     # below every floor
+
+    def test_replica_and_route(self):
+        rep = get_algorithm("optimize_job_replica_count")
+        route = get_algorithm("optimize_job_recovery_route")
+        assert rep([], [], self._cfg(1e9)) == 1
+        assert rep([], [], self._cfg(20.0)) == 2
+        assert route([], [], self._cfg(1e9)) == ("cold", "shm")
+        # hot regime with a ring: keep the pool warm, restore from peers
+        assert route([], [], self._cfg(20.0, replica_count=2)) == \
+            ("warm", "replica")
+        # hot regime WITHOUT a ring: warm route but no replica tier
+        assert route([], [], self._cfg(20.0, replica_count=1)) == \
+            ("warm", "shm")
+
+
+# -------------------------------------------------------------------- prior
+
+
+class TestLoadPrior:
+    def test_calibrates_step_and_ckpt_cost_from_curve(self, tmp_path):
+        p = tmp_path / "preempt_table.json"
+        p.write_text(json.dumps({
+            "dt": 0.05,
+            "rows": [{"interval": 10, "goodput": 0.78},
+                     {"interval": 200, "goodput": 0.97}]}))
+        prior = load_prior(str(p))
+        assert prior["step_time_s"] == 0.05
+        # C = dt·(g2-g1)/(1/I1 - 1/I2) = 0.05*0.19/0.095 = 0.1
+        assert prior["ckpt_cost_s"] == pytest.approx(0.1, rel=1e-6)
+
+    def test_missing_or_garbage_file_keeps_defaults(self, tmp_path):
+        assert load_prior(str(tmp_path / "nope.json")) == {}
+        p = tmp_path / "bad.json"
+        p.write_text("not json")
+        assert load_prior(str(p)) == {}
+
+    def test_config_overrides_flow_into_engine(self, tmp_path):
+        p = tmp_path / "prior.json"
+        p.write_text(json.dumps({
+            "dt": 0.05,
+            "rows": [{"interval": 10, "goodput": 0.78},
+                     {"interval": 200, "goodput": 0.97}],
+            "config": {"tau_s": 20.0, "max_interval_steps": 200,
+                       "fused_ladder": [[4, 300.0]],
+                       "step_time_s": 99.0,       # must NOT apply
+                       "no_such_knob": 7}}))      # must be ignored
+        eng = PolicyEngine(prior_path=str(p), clock=FakeClock())
+        assert eng.cfg.tau_s == 20.0
+        assert eng.cfg.max_interval_steps == 200
+        assert eng.cfg.fused_ladder == ((4, 300.0),)
+        # calibration comes from the CURVE, not the config block
+        assert eng.cfg.step_time_s == 0.05
+        assert eng.cfg.ckpt_cost_s == pytest.approx(0.1, rel=1e-6)
+        assert not hasattr(eng.cfg, "no_such_knob")
+
+
+# -------------------------------------------------------------------- engine
+
+
+class TestPolicyEngine:
+    def test_quiet_then_burst_then_cooldown(self):
+        clk = FakeClock()
+        eng = PolicyEngine(PolicyConfig(tau_s=30.0), clock=clk)
+        quiet = eng.propose()
+        assert quiet.ckpt_interval_steps == 500
+        assert quiet.fused_steps == 4
+        assert quiet.replica_count == 1
+        assert quiet.recovery_route == "cold"
+        # the interval lands on a fusion-boundary multiple of K
+        assert quiet.ckpt_interval_steps % quiet.fused_steps == 0
+        # burst: 4 failures inside 3s collapses every knob
+        for t in (10.0, 11.0, 12.0, 13.0):
+            clk.t = t
+            eng.record_failure()
+        burst = eng.propose()
+        assert burst.ckpt_interval_steps < quiet.ckpt_interval_steps
+        assert burst.fused_steps == 1
+        assert burst.replica_count == 2
+        assert burst.recovery_route == "warm"
+        assert burst.preferred_tier == "replica"
+        assert burst.preempt_rate_per_hr > quiet.preempt_rate_per_hr
+        assert "mtbf=" in burst.reason
+        # several tau later the regime cools back off
+        clk.t = 13.0 + 10 * 30.0
+        cooled = eng.propose()
+        assert cooled.ckpt_interval_steps == 500
+        assert cooled.fused_steps == 4
+
+    def test_hysteresis_suppresses_noise(self):
+        clk = FakeClock()
+        eng = PolicyEngine(PolicyConfig(tau_s=30.0), clock=clk)
+        first = eng.maybe_decide()
+        assert first is not None
+        # nothing changed: no decision thrash
+        clk.t = 1.0
+        assert eng.maybe_decide() is None
+        # regime shift: a new decision fires
+        for t in (2.0, 2.5, 3.0):
+            clk.t = t
+            eng.record_failure()
+        second = eng.maybe_decide()
+        assert second is not None
+        assert second.fused_steps == 1
+
+    def test_note_emitted_restores_baseline(self):
+        """A restarted master replays journaled decisions through
+        note_emitted: the hysteresis baseline must come back, so an
+        identical proposal does not re-fire."""
+        clk = FakeClock()
+        eng = PolicyEngine(PolicyConfig(tau_s=30.0), clock=clk)
+        d = eng.propose()
+        eng2 = PolicyEngine(PolicyConfig(tau_s=30.0), clock=clk)
+        eng2.note_emitted(d)
+        assert eng2.maybe_decide() is None
+
+    def test_observe_goodput_lands_in_reason(self):
+        eng = PolicyEngine(PolicyConfig(), clock=FakeClock())
+        eng.observe_goodput({"goodput_fraction": 0.875})
+        assert "goodput=0.875" in eng.propose().reason
+
+
+# ------------------------------------------------------------ message schema
+
+
+class TestPolicyDecisionSchema:
+    # ADD-ONLY (like the telemetry schemas, tests/test_telemetry.py):
+    # trainers/agents/report tools key off these names and old journals
+    # must replay into new masters — extend, never rename or remove.
+    PINNED = {"decision_id", "ckpt_interval_steps", "replica_count",
+              "fused_steps", "recovery_route", "preferred_tier",
+              "preempt_rate_per_hr", "reason", "issued_at"}
+
+    def test_decision_fields_add_only(self):
+        names = {f.name for f in dataclasses.fields(msg.PolicyDecision)}
+        assert names >= self.PINNED
+        missing = self.PINNED - names
+        assert not missing, f"ADD-ONLY schema lost fields: {missing}"
+
+    def test_no_change_sentinels(self):
+        d = msg.PolicyDecision()
+        assert d.ckpt_interval_steps == 0   # 0 = leave cadence alone
+        assert d.replica_count == -1        # -1 = leave ring alone
+        assert d.fused_steps == 0           # 0 = leave K alone
+        assert d.recovery_route == ""
+        assert d.preferred_tier == ""
+
+    def test_report_roundtrips_through_serializer(self):
+        from dlrover_wuqiong_tpu.common import serialize
+
+        d = msg.PolicyDecision(decision_id=3, ckpt_interval_steps=40,
+                               replica_count=2, fused_steps=1,
+                               recovery_route="warm",
+                               preferred_tier="replica",
+                               preempt_rate_per_hr=180.0, reason="burst",
+                               issued_at=123.0)
+        blob = serialize.dumps(msg.PolicyDecisionReport(node_id=7,
+                                                        decision=d))
+        back = serialize.loads(blob)
+        assert back.decision == d
+        assert back.node_id == 7

@@ -50,7 +50,16 @@ def _from_master(addr: str) -> dict:
 def _from_flight(ckpt_dir: str) -> dict:
     from dlrover_wuqiong_tpu.telemetry import load_flight_dumps
 
+    if not os.path.isdir(ckpt_dir):
+        raise FileNotFoundError(
+            f"--flight: {ckpt_dir!r} is not a directory")
     dumps = load_flight_dumps(ckpt_dir)
+    if not dumps:
+        # an all-zero report would read as "job was perfectly idle";
+        # no dumps is a different fact (nothing flushed, or wrong dir)
+        raise FileNotFoundError(
+            f"--flight: no flight-recorder dumps under "
+            f"{os.path.join(ckpt_dir, 'flight')!r}")
     # a process may have flushed several times — its ledger snapshots
     # are cumulative, so only the LATEST per (role, pid) counts
     latest = {}

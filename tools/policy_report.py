@@ -1,0 +1,103 @@
+"""Adaptive-policy report: ONE JSON line for the driver/operator.
+
+Two sources, same shape (common/messages.py PolicyDecision fields):
+
+    python tools/policy_report.py [--addr HOST:PORT]  # live master RPC
+    python tools/policy_report.py --journal DIR       # offline journal
+
+Live mode asks the master for the CURRENT decision (the one trainers
+poll at fusion boundaries) plus the retained decision history.  Offline
+mode reconstructs the decision log from the master journal alone
+(snapshot "policy" list + kind=="policy" frames — the durability
+contract brain/policy.py documents), so a post-mortem can audit what
+the policy engine did without any process alive.
+
+Fields: current (knob dict or null), history_len, decision ids, and the
+latest preemption-rate/reason context.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _as_dict(d) -> dict:
+    if isinstance(d, dict):
+        return dict(d)
+    fields = ("decision_id", "ckpt_interval_steps", "replica_count",
+              "fused_steps", "recovery_route", "preferred_tier",
+              "preempt_rate_per_hr", "reason", "issued_at")
+    return {k: getattr(d, k) for k in fields if hasattr(d, k)}
+
+
+def _from_master(addr: str) -> dict:
+    from dlrover_wuqiong_tpu.agent.master_client import MasterClient
+
+    mc = MasterClient(addr, node_id=-1)
+    try:
+        current = _as_dict(mc.get_policy_decision())
+        history = [_as_dict(d) for d in mc.get_policy_history()]
+    finally:
+        mc.close()
+    return {
+        "source": "master", "addr": addr,
+        "current": current if current.get("decision_id") else None,
+        "history_len": len(history),
+        "decision_ids": [h.get("decision_id") for h in history],
+    }
+
+
+def _from_journal(journal_dir: str) -> dict:
+    from dlrover_wuqiong_tpu.master.journal import MasterJournal
+
+    if not os.path.isdir(journal_dir):
+        raise FileNotFoundError(
+            f"--journal: {journal_dir!r} is not a directory")
+    snap, entries = MasterJournal(journal_dir, fsync=False).load()
+    decisions = [_as_dict(d) for d in (snap or {}).get("policy") or []]
+    decisions += [_as_dict(e["data"]["decision"]) for e in entries
+                  if e.get("kind") == "policy"]
+    decisions.sort(key=lambda d: d.get("decision_id", 0))
+    return {
+        "source": "journal", "journal_dir": journal_dir,
+        "current": decisions[-1] if decisions else None,
+        "history_len": len(decisions),
+        "decision_ids": [d.get("decision_id") for d in decisions],
+    }
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    journal = addr = None
+    it = iter(argv)
+    for a in it:
+        if a == "--journal":
+            journal = next(it, None)
+        elif a == "--addr":
+            addr = next(it, None)
+        elif a in ("-h", "--help"):
+            print(__doc__, file=sys.stderr)
+            return 0
+    try:
+        if journal:
+            report = _from_journal(journal)
+        else:
+            addr = addr or os.getenv("DWT_MASTER_ADDR", "")
+            if not addr:
+                print(json.dumps({"error": "no master address: pass "
+                                  "--addr, set DWT_MASTER_ADDR, or use "
+                                  "--journal DIR"}))
+                return 2
+            report = _from_master(addr)
+    except Exception as e:  # noqa: BLE001 — the JSON contract beats purity
+        print(json.dumps({"error": repr(e)[:500]}))
+        return 1
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
