@@ -3,20 +3,31 @@
 Parity: reference `dlrover/python/diagnosis/` + `elastic_agent/monitor/`
 (error_monitor.py:1, node_check.py:1) diagnose distributed failures at
 RUNTIME; graftlint moves the TPU-costly bug classes to a pre-execution
-contract.  Two engines:
+contract.  Five engines share one finding model + rule catalog
+(findings.RULE_CATALOG):
 
-- Engine A (`jaxpr_engine`) inspects traced train steps without
-  executing them: collective-in-cond deadlocks, CSE-undone remat,
-  donation vs optimizer_offload aliasing, host-kind out_shardings.
-- Engine B (`ast_engine`) scans source text: trace-time ``DWT_*`` env
-  reads missing from the compile-cache key, donated-buffer reuse,
+- `ast_engine` scans source text: trace-time ``DWT_*`` env reads
+  missing from the compile-cache key, donated-buffer reuse,
   control-plane pickle/fork hygiene, module docstring citations.
+- `protocol_engine` checks interprocedural control-plane invariants
+  over a per-module call graph: journal-before-ack, idem keys,
+  commit ordering, atomic publishes, lock leaks.
+- `concurrency_engine` checks lock discipline on the same call-graph
+  machinery: blocking-under-lock, lock-order cycles, unguarded
+  shared state across threads, thread lifecycles.
+- `jaxpr_engine` inspects traced train steps without executing them:
+  collective-in-cond deadlocks, CSE-undone remat, donation vs
+  optimizer_offload aliasing, host-kind out_shardings.
+- `hlo_budget` AOT-lowers the real train step per strategy and audits
+  collective-op counts against checked-in analytic budgets.
 
-CLI: ``python -m dlrover_wuqiong_tpu.analysis [--engine jaxpr|ast|all]
-[path...]`` — single-line JSON summary on stdout (bench.py contract),
-file:line findings on stderr, exit 1 on any finding.  This module and
-Engine B import no jax so ``__graft_entry__.py`` can pre-flight the AST
-checks before any backend initialization; Engine A is imported lazily.
+CLI: ``python -m dlrover_wuqiong_tpu.analysis [--engine
+jaxpr|ast|protocol|concurrency|hlo|all] [--format json|sarif]
+[path...]`` — single-line JSON (or SARIF) summary on stdout (bench.py
+contract), file:line findings on stderr, exit 1 on any non-warning
+finding.  This module and the ast/protocol/concurrency engines import
+no jax so ``__graft_entry__.py`` can pre-flight them before any
+backend initialization; jaxpr/hlo are imported lazily.
 """
 
 from .ast_engine import run_paths as run_ast_engine  # noqa: F401

@@ -6,14 +6,18 @@ same contract is a standalone gate shaped like bench.py: ONE JSON line
 on stdout (machine-readable for CI/driver), human findings on stderr,
 exit code 1 when any rule is violated.
 
-Engine selection: ``--engine ast`` / ``--engine protocol`` need no jax
-at all (the `__graft_entry__.py` pre-flight runs both); ``--engine
-jaxpr`` / ``--engine hlo`` self-provision a virtual CPU platform (the
-audit/budget meshes need 8 devices) BEFORE jax initializes any backend,
-so running them on a machine with a live TPU tunnel never touches a
-chip.  ``--changed`` restricts the file-scanning engines to the git
-diff (fast CI mode; the whole-program jaxpr/hlo engines are skipped).
-``--catalog`` prints the rule catalog as the one JSON line and exits 0.
+Engine selection: ``--engine ast`` / ``--engine protocol`` /
+``--engine concurrency`` need no jax at all (the `__graft_entry__.py`
+pre-flight runs all three); ``--engine jaxpr`` / ``--engine hlo``
+self-provision a virtual CPU platform (the audit/budget meshes need 8
+devices) BEFORE jax initializes any backend, so running them on a
+machine with a live TPU tunnel never touches a chip.  ``--changed``
+restricts the file-scanning engines to the git diff (fast CI mode; the
+whole-program jaxpr/hlo engines are skipped).  ``--catalog`` prints the
+rule catalog as the one JSON line and exits 0.  ``--format sarif``
+swaps the stdout line for a SARIF 2.1.0 document (still exactly one
+line) so CI annotates findings in place; exit code semantics are
+unchanged.
 
 The JSON schema is a compatibility contract (tests/test_analysis.py
 pins it): keys are only ever ADDED to the ``graftlint`` object.
@@ -85,8 +89,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         description="graftlint: static SPMD-correctness and "
                     "control-plane-protocol checks")
     parser.add_argument("--engine",
-                        choices=("jaxpr", "ast", "protocol", "hlo", "all"),
+                        choices=("jaxpr", "ast", "protocol", "concurrency",
+                                 "hlo", "all"),
                         default="all")
+    parser.add_argument("--format", choices=("json", "sarif"),
+                        default="json",
+                        help="stdout format: the graftlint JSON line "
+                             "(default) or a SARIF 2.1.0 document for CI "
+                             "annotation (still one line)")
     parser.add_argument("--devices", type=int, default=8,
                         help="virtual CPU devices for the jaxpr/hlo "
                              "audits")
@@ -94,8 +104,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="cap on stderr finding lines")
     parser.add_argument("--changed", action="store_true",
                         help="fast mode: scan only git-diff'd .py files "
-                             "with the ast+protocol engines (jaxpr/hlo "
-                             "are whole-program and are skipped)")
+                             "with the ast+protocol+concurrency engines "
+                             "(jaxpr/hlo are whole-program and are "
+                             "skipped)")
     parser.add_argument("--catalog", action="store_true",
                         help="print the rule catalog as the one JSON "
                              "line and exit")
@@ -105,7 +116,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = parser.parse_args(argv)
 
     from .findings import (catalog_json, render_report, summarize,
-                           summarize_severity)
+                           summarize_severity, to_sarif)
 
     if args.catalog:
         print(json.dumps({"graftlint_catalog": catalog_json()}))
@@ -137,6 +148,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         files_scanned = max(files_scanned, n_files)
         findings.extend(proto_findings)
         engines.append("protocol")
+    if args.engine in ("concurrency", "all") and run_file_engines:
+        from .concurrency_engine import run_paths as run_concurrency
+
+        conc_findings, n_files = run_concurrency(scan_paths)
+        files_scanned = max(files_scanned, n_files)
+        findings.extend(conc_findings)
+        engines.append("concurrency")
     if args.engine in ("jaxpr", "all") and run_trace_engines:
         _provision_cpu(args.devices)
         from .jaxpr_engine import self_audit
@@ -155,6 +173,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(render_report(findings, limit=args.max_report),
               file=sys.stderr)
     gating = [f for f in findings if f.severity != "warning"]
+    if args.format == "sarif":
+        # one-line SARIF 2.1.0 document instead of the graftlint object;
+        # same exit-code semantics so CI gates identically.
+        print(json.dumps(to_sarif(findings)))
+        return 1 if gating else 0
     # bench.py contract: exactly one JSON line on stdout.  Schema
     # evolution is ADD-ONLY (tests/test_analysis.py pins it).
     print(json.dumps({

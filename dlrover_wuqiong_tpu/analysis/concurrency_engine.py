@@ -1,0 +1,764 @@
+"""graftlint concurrency engine: lock discipline + shared-state races.
+
+Parity: no reference counterpart — reference dlrover's concurrency
+discipline (elastic_agent/torch/training.py thread lifecycles,
+common/multi_process.py SharedLock protocol) exists only as runtime
+behavior, and its failure mode is the chaos-drill wedge.  This repo's two
+worst historical outages were exactly that class (CLAUDE.md):
+
+- **PR 1 wedge**: a SIGKILLed SharedLock holder stalled the next worker
+  generation's first shm staging for the full 600s SAVE_TIMEOUT — a
+  blocking wait reachable while a cross-process lock was held.
+- **PR 4 wedge**: the replica backup dialed a dead peer socket *inside*
+  the shm staging-lock span, burning a 150s RPC floor per call with the
+  lock held (the fix hoisted the dial out of ``_segment_bytes``;
+  checkpoint/replica.py documents the shape).
+
+Both are visible in the source: a blocking operation (socket dial, RPC,
+``retry_call``, ``fsync``, ``sleep``, subprocess spawn) transitively
+reachable from a lock-held region.  This engine makes that whole class a
+lint failure instead of a chaos-drill discovery.  It reuses the protocol
+engine's per-module call graph and transitive-effect closure
+(protocol_engine.ModuleGraph) and, like it, imports no jax — it runs in
+the ``__graft_entry__.py`` pre-flight before any backend exists.
+
+Rules (catalog + severities in findings.RULE_CATALOG):
+
+- ``blocking-under-lock``: a blocking call (BLOCKING table: socket dial /
+  ``retry_call`` / frame IO / ``fsync`` / ``time.sleep`` / subprocess
+  spawn / bulk socket IO) lexically inside a ``with lock:`` body or an
+  ``acquire()``-to-``release()`` span, directly or transitively through
+  local calls.  Cross-process SharedLocks make this a *generation* wedge
+  (the lock outlives the holder's death), in-process locks make it a
+  convoy; both shapes are flagged.  The lock/IPC implementation itself
+  (LOCK_IMPL_FILES — its client lock exists to serialize the socket) is
+  sanctioned.
+- ``lock-order-cycle``: lock A held when lock B is acquired (directly or
+  through local calls) adds ordering edge A→B; a cycle in the per-module
+  edge graph is a potential ABBA deadlock.  Lock identities are resolved
+  per class (``self._lock`` in two classes are two locks) so the graph
+  never aliases unrelated locks.
+- ``unguarded-shared-state``: a ``self.X`` attribute mutated inside a
+  ``threading.Thread(target=self._run)``-style worker method while
+  another method of the same class mutates it with no common lock
+  guarding both sites (write-write race), or accesses it under a lock
+  the worker write does not hold (inconsistent guard — the lock protects
+  nothing).  Lock-/event-/queue-typed attributes are exempt (their
+  methods are thread-safe); plain loads racing a GIL-atomic flag write
+  are NOT flagged (idiomatic stop-flag passing).  Worker targets are
+  resolved from ``target=self.<method>`` bound-method references;
+  nested-closure targets are out of scope (separate function scopes).
+- ``thread-lifecycle``: a non-daemon ``threading.Thread`` started with
+  no ``join()`` reachable on any shutdown path (``self.X`` threads:
+  anywhere in the class; local threads: in the same function) and no
+  ``daemon=True``/``.daemon = True`` mark — the interpreter hangs at
+  exit waiting on it, which is exactly how a "finished" job keeps its
+  pod alive.  Tests are exempt from this rule and from
+  unguarded-shared-state (short-lived scaffolding, not services);
+  the two wedge rules run everywhere, tests included — a deadlocked
+  test wedges CI just as hard.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .findings import Finding, is_suppressed
+from .protocol_engine import FuncInfo, ModuleGraph, _dotted, _terminal
+
+# --------------------------------------------------------------- tables
+# The tables ARE the spec, like the protocol engine's verb tables: a new
+# blocking primitive or lock constructor gets added here in the same PR
+# that introduces it.
+
+#: dotted call names that block the calling thread unconditionally.
+BLOCKING_DOTTED = {"time.sleep", "_time.sleep"}
+
+#: terminal callee names that block regardless of receiver.
+BLOCKING_TERMINALS = {
+    "create_connection",   # socket dial (the PR 4 wedge primitive)
+    "retry_call",          # the shared RPC policy: bounded but LONG
+    "_send_frame", "_recv_frame",   # frame-level control-plane IO
+    "fsync",               # storage durability barrier
+    "urlopen",             # http fetch
+    "sendall",             # bulk socket IO (replica blob transfers)
+}
+
+#: subprocess spawn: ``subprocess.run(...)``, ``subprocess.Popen(...)``…
+SUBPROCESS_TERMINALS = {"run", "call", "check_output", "check_call",
+                        "Popen"}
+
+#: receiver fragments that mark ``.connect()``/``.recv()`` as socket IO.
+SOCKET_RECEIVER_HINTS = ("sock", "conn", "request")
+
+#: constructors whose result is a lock (attr-type resolution).
+LOCK_CONSTRUCTORS = {"Lock", "RLock", "Condition", "SharedLock",
+                     "Semaphore", "BoundedSemaphore"}
+
+#: constructors whose result is internally synchronized — attributes of
+#: these types are exempt from unguarded-shared-state (their methods are
+#: thread-safe; rebinding them post-init is the bug the rule would still
+#: catch via the write-write arm if both writes are bare).
+THREADSAFE_CONSTRUCTORS = LOCK_CONSTRUCTORS | {
+    "Event", "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
+    "deque", "Barrier", "local",
+}
+
+#: the SharedLock/IPC and RPC transport implementations: their client
+#: locks exist to SERIALIZE the client socket — the exchange IS the
+#: critical section (LocalSocketComm._client_lock, RpcClient._lock) —
+#: and the lock server's poll loop sleeps by design.  Callers above the
+#: transport still get checked.
+LOCK_IMPL_FILES = ("common/multi_process.py", "common/comm.py")
+
+
+def _is_test_path(path: str) -> bool:
+    parts = path.replace(os.sep, "/").split("/")
+    return "tests" in parts or parts[-1].startswith("test_")
+
+
+# ---------------------------------------------------------- lock naming
+
+
+def _class_attr_types(tree: ast.Module) -> Dict[str, Dict[str, str]]:
+    """class -> {attr -> constructor terminal} for ``self.X = Ctor(...)``
+    assignments anywhere in the class (``__init__`` and helpers alike)."""
+    out: Dict[str, Dict[str, str]] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        attrs: Dict[str, str] = {}
+        for child in ast.walk(node):
+            if not isinstance(child, ast.Assign):
+                continue
+            value = child.value
+            # unwrap `X() if cond else None` (the master=True idiom)
+            if isinstance(value, ast.IfExp):
+                value = value.body
+            if not isinstance(value, ast.Call):
+                continue
+            ctor = _terminal(value.func)
+            if ctor not in THREADSAFE_CONSTRUCTORS:
+                continue
+            for t in child.targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self":
+                    attrs[t.attr] = ctor
+        if attrs:
+            out[node.name] = attrs
+    return out
+
+
+class LockNamer:
+    """Resolves AST expressions to canonical per-module lock identities.
+
+    ``self._lock`` inside class C -> ``C._lock`` (two classes never
+    alias); anything else keeps its dotted text.  An expression is a
+    lock when its attr is lock-TYPED (assigned from a LOCK_CONSTRUCTORS
+    call in the class) or lock-NAMED ("lock"/"mutex" in the dotted
+    text — covers parameters and cross-object handles the type pass
+    cannot see).
+    """
+
+    def __init__(self, attr_types: Dict[str, Dict[str, str]]):
+        self._attr_types = attr_types
+
+    def lock_id(self, expr: ast.AST, cls: Optional[str]) -> Optional[str]:
+        dotted = _dotted(expr)
+        if not dotted:
+            return None
+        parts = dotted.split(".")
+        if parts[0] == "self" and cls:
+            canon = f"{cls}.{'.'.join(parts[1:])}"
+            attr = parts[1] if len(parts) > 1 else ""
+            ctor = self._attr_types.get(cls, {}).get(attr)
+            if ctor in LOCK_CONSTRUCTORS:
+                return canon
+            if self._looks_locky(dotted):
+                return canon
+            return None
+        if self._looks_locky(dotted):
+            return dotted
+        return None
+
+    @staticmethod
+    def _looks_locky(dotted: str) -> bool:
+        low = dotted.lower()
+        return "lock" in low or "mutex" in low
+
+    def attr_ctor(self, cls: Optional[str], attr: str) -> Optional[str]:
+        return self._attr_types.get(cls or "", {}).get(attr)
+
+
+# ------------------------------------------------------------ regions
+
+
+class LockRegion:
+    """One lock-held span inside a function, as a closed line interval."""
+
+    __slots__ = ("lock_id", "start", "end", "via", "lineno")
+
+    def __init__(self, lock_id: str, start: int, end: int, via: str,
+                 lineno: int):
+        self.lock_id = lock_id
+        self.start = start      # first line INSIDE the held span
+        self.end = end          # last line of the held span
+        self.via = via          # "with" | "acquire"
+        self.lineno = lineno    # the with/acquire line (for messages)
+
+    def contains(self, line: int) -> bool:
+        return self.start <= line <= self.end
+
+
+def _node_end(node: ast.AST) -> int:
+    return max((getattr(n, "end_lineno", None) or
+                getattr(n, "lineno", 0) for n in ast.walk(node)),
+               default=getattr(node, "lineno", 0))
+
+
+def lock_regions(info: FuncInfo, namer: LockNamer) -> List[LockRegion]:
+    """All lock-held line spans in one function.
+
+    ``with lock:`` bodies are exact; ``x.acquire()`` spans run to the
+    first subsequent ``x.release()`` line in the same function (the
+    in-tree ``acquire; try: ... finally: release`` idiom keeps the
+    finally's release line AFTER the guarded body, so line intervals are
+    faithful), else to the function's end — matching the protocol
+    engine's lock-leak view of an unreleased acquire.
+    """
+    regions: List[LockRegion] = []
+    releases: Dict[str, List[int]] = {}
+    acquires: List[Tuple[str, int]] = []
+    for node in ast.walk(info.node):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                lid = namer.lock_id(item.context_expr, info.cls)
+                if lid and node.body:
+                    regions.append(LockRegion(
+                        lid, node.body[0].lineno, _node_end(node),
+                        "with", node.lineno))
+        elif isinstance(node, ast.Call):
+            term = _terminal(node.func)
+            if term in ("acquire", "release") and \
+                    isinstance(node.func, ast.Attribute):
+                lid = namer.lock_id(node.func.value, info.cls)
+                if lid is None:
+                    continue
+                if term == "acquire":
+                    acquires.append((lid, node.lineno))
+                else:
+                    releases.setdefault(lid, []).append(node.lineno)
+    fn_end = _node_end(info.node)
+    for lid, line in acquires:
+        later = sorted(r for r in releases.get(lid, []) if r >= line)
+        end = later[0] if later else fn_end
+        regions.append(LockRegion(lid, line + 1, end - 1 if later else end,
+                                  "acquire", line))
+    return [r for r in regions if r.start <= r.end]
+
+
+# ------------------------------------------------------ blocking calls
+
+
+def blocking_reason(call: ast.Call) -> Optional[str]:
+    """Why `call` blocks the calling thread, or None."""
+    dotted = _dotted(call.func) or ""
+    term = _terminal(call.func) or ""
+    if dotted in BLOCKING_DOTTED or \
+            (term == "sleep" and dotted.split(".")[0] in ("time", "_time",
+                                                          "gevent")):
+        return "time.sleep"
+    if term in BLOCKING_TERMINALS:
+        return {"create_connection": "socket dial",
+                "retry_call": "retry_call RPC",
+                "fsync": "fsync",
+                "sendall": "bulk socket send",
+                "urlopen": "http fetch"}.get(term, f"{term} frame IO")
+    if term in ("connect", "recv", "accept") and \
+            isinstance(call.func, ast.Attribute):
+        recv = (_dotted(call.func.value) or "").lower()
+        if any(h in recv for h in SOCKET_RECEIVER_HINTS):
+            return f"socket {term}"
+    if term in SUBPROCESS_TERMINALS:
+        root = dotted.split(".")[0]
+        if root in ("subprocess", "sp") or term == "Popen":
+            return "subprocess spawn"
+    if term == "_request":
+        # LocalSocketComm RPC: a unix-socket round trip (plus the 150s
+        # dial floor when the resource master is gone)
+        return "cross-process IPC round trip"
+    return None
+
+
+# ------------------------------------------------------ effect marking
+
+
+def mark_concurrency_effects(graph: ModuleGraph, namer: LockNamer) -> None:
+    """Stamp 'blocking' / 'acquires:<lock>' direct effects per function,
+    pre-closure.  The protocol engine's transitive_effects then answers
+    "does anything reachable from f block / take lock L"."""
+    for info in graph.funcs.values():
+        for node in ast.walk(info.node):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    lid = namer.lock_id(item.context_expr, info.cls)
+                    if lid:
+                        info.effects.add(f"acquires:{lid}")
+            elif isinstance(node, ast.Call):
+                if blocking_reason(node):
+                    info.effects.add("blocking")
+                term = _terminal(node.func)
+                if term == "acquire" and \
+                        isinstance(node.func, ast.Attribute):
+                    lid = namer.lock_id(node.func.value, info.cls)
+                    if lid:
+                        info.effects.add(f"acquires:{lid}")
+
+
+def _calls_in_span(info: FuncInfo, region: LockRegion) -> List[ast.Call]:
+    out = []
+    for node in ast.walk(info.node):
+        if isinstance(node, ast.Call) and region.contains(node.lineno):
+            out.append(node)
+    return out
+
+
+# ------------------------------------------- rule: blocking-under-lock
+
+
+def check_blocking_under_lock(path: str, tree: ast.Module,
+                              source_lines: Sequence[str],
+                              graph: ModuleGraph,
+                              namer: LockNamer) -> List[Finding]:
+    norm = path.replace(os.sep, "/")
+    if any(norm.endswith(f) for f in LOCK_IMPL_FILES):
+        return []
+    findings: List[Finding] = []
+    for info in graph.funcs.values():
+        for region in lock_regions(info, namer):
+            reported: Set[int] = set()
+            for call in _calls_in_span(info, region):
+                term = _terminal(call.func)
+                if term in ("acquire", "release"):
+                    continue  # nested lock ops are lock-order's domain
+                reason = blocking_reason(call)
+                via = ""
+                if reason is None:
+                    target = graph.resolve(call, info.cls)
+                    if target and "blocking" in \
+                            graph.transitive_effects(target):
+                        reason = "a transitively blocking call"
+                        via = f" via {target}()"
+                if reason is None:
+                    continue
+                if call.lineno in reported:
+                    continue
+                if is_suppressed(source_lines, call.lineno,
+                                 "blocking-under-lock"):
+                    continue
+                reported.add(call.lineno)
+                findings.append(Finding(
+                    "blocking-under-lock",
+                    f"{info.qualname} reaches {reason}{via} while holding "
+                    f"{region.lock_id} ({region.via} at line "
+                    f"{region.lineno}) — a slow/dead peer turns the lock "
+                    f"into a wedge for every waiter (and a SIGKILL here "
+                    f"wedges the next worker generation for the full "
+                    f"timeout); move the blocking work outside the lock "
+                    f"span (copy under the lock, send after release)",
+                    path, call.lineno))
+    return findings
+
+
+# --------------------------------------------- rule: lock-order-cycle
+
+
+def _lock_edges(graph: ModuleGraph, namer: LockNamer
+                ) -> List[Tuple[str, str, str, int]]:
+    """(held, acquired, qualname, line) ordering edges across the module."""
+    edges: List[Tuple[str, str, str, int]] = []
+    for info in graph.funcs.values():
+        for region in lock_regions(info, namer):
+            inner: Set[Tuple[str, int]] = set()
+            for node in ast.walk(info.node):
+                if isinstance(node, (ast.With, ast.AsyncWith)) and \
+                        region.contains(node.lineno) and \
+                        node.lineno != region.lineno:
+                    for item in node.items:
+                        lid = namer.lock_id(item.context_expr, info.cls)
+                        if lid:
+                            inner.add((lid, node.lineno))
+                elif isinstance(node, ast.Call) and \
+                        region.contains(node.lineno):
+                    term = _terminal(node.func)
+                    if term == "acquire" and \
+                            isinstance(node.func, ast.Attribute) and \
+                            node.lineno != region.lineno:
+                        lid = namer.lock_id(node.func.value, info.cls)
+                        if lid:
+                            inner.add((lid, node.lineno))
+                    target = graph.resolve(node, info.cls) \
+                        if isinstance(node, ast.Call) else None
+                    if target:
+                        for eff in graph.transitive_effects(target):
+                            if eff.startswith("acquires:"):
+                                inner.add((eff.split(":", 1)[1],
+                                           node.lineno))
+            for lid, line in inner:
+                if lid != region.lock_id:
+                    edges.append((region.lock_id, lid, info.qualname,
+                                  line))
+    return edges
+
+
+def check_lock_order_cycle(path: str, tree: ast.Module,
+                           source_lines: Sequence[str],
+                           graph: ModuleGraph,
+                           namer: LockNamer) -> List[Finding]:
+    edges = _lock_edges(graph, namer)
+    adj: Dict[str, Set[str]] = {}
+    where: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    for a, b, qual, line in edges:
+        adj.setdefault(a, set()).add(b)
+        where.setdefault((a, b), (qual, line))
+    findings: List[Finding] = []
+    seen_cycles: Set[Tuple[str, ...]] = set()
+
+    def dfs(start: str, node: str, trail: List[str]):
+        for nxt in sorted(adj.get(node, ())):
+            if nxt == start:
+                cycle = trail + [start]
+                key = tuple(sorted(cycle[:-1]))
+                if key in seen_cycles:
+                    continue
+                seen_cycles.add(key)
+                qual, line = where[(cycle[0], cycle[1])]
+                if is_suppressed(source_lines, line, "lock-order-cycle"):
+                    continue
+                findings.append(Finding(
+                    "lock-order-cycle",
+                    f"lock ordering cycle {' -> '.join(cycle)} (edge "
+                    f"{cycle[0]} -> {cycle[1]} in {qual}) — two threads "
+                    f"entering from opposite ends deadlock; impose one "
+                    f"global acquisition order or collapse to one lock",
+                    path, line))
+            elif nxt not in trail:
+                dfs(start, nxt, trail + [nxt])
+
+    for start in sorted(adj):
+        dfs(start, start, [start])
+    return findings
+
+
+# ------------------------------------- rule: unguarded-shared-state
+
+
+def _worker_methods(tree: ast.Module) -> Dict[str, Set[str]]:
+    """class -> method names used as ``Thread(target=self.<m>)``."""
+    out: Dict[str, Set[str]] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        targets: Set[str] = set()
+        for child in ast.walk(node):
+            if not (isinstance(child, ast.Call)
+                    and _terminal(child.func) == "Thread"):
+                continue
+            for kw in child.keywords:
+                if kw.arg == "target" and \
+                        isinstance(kw.value, ast.Attribute) and \
+                        isinstance(kw.value.value, ast.Name) and \
+                        kw.value.value.id == "self":
+                    targets.add(kw.value.attr)
+        if targets:
+            out[node.name] = targets
+    return out
+
+
+class _AttrSites:
+    """Guard sets per self-attribute access site within one method."""
+
+    def __init__(self):
+        self.writes: Dict[str, List[Tuple[int, frozenset]]] = {}
+        self.reads: Dict[str, List[Tuple[int, frozenset]]] = {}
+        self.first_join: Optional[int] = None  # line of first .join() call
+
+
+def _attr_sites(info: FuncInfo, namer: LockNamer) -> _AttrSites:
+    regions = lock_regions(info, namer)
+
+    def guards(line: int) -> frozenset:
+        return frozenset(r.lock_id for r in regions if r.contains(line))
+
+    sites = _AttrSites()
+    for node in ast.walk(info.node):
+        if isinstance(node, ast.Call) and _terminal(node.func) == "join" \
+                and isinstance(node.func, ast.Attribute):
+            if sites.first_join is None or node.lineno < sites.first_join:
+                sites.first_join = node.lineno
+        if not (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            continue
+        entry = (node.lineno, guards(node.lineno))
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            sites.writes.setdefault(node.attr, []).append(entry)
+        else:
+            sites.reads.setdefault(node.attr, []).append(entry)
+    return sites
+
+
+def _expand_worker_set(graph: ModuleGraph, cls: str,
+                       targets: Set[str]) -> Set[str]:
+    """Worker-CONFINED closure: a private method whose every in-class
+    caller is already in the worker set runs only on the worker thread
+    (the ``_sync_shm_to_storage -> _update_shard_num`` shape) — its
+    writes are same-thread, not races.  Public methods stay out (other
+    modules may call them from any thread)."""
+    members = {i.qualname.split(".")[-1]: i for i in graph.funcs.values()
+               if i.cls == cls}
+    callers: Dict[str, Set[str]] = {}
+    for name, info in members.items():
+        for callee in info.calls:
+            if callee.startswith(f"{cls}."):
+                callers.setdefault(callee.split(".")[-1], set()).add(name)
+    out = set(targets)
+    changed = True
+    while changed:
+        changed = False
+        for name in members:
+            if name in out or not name.startswith("_") or \
+                    name.startswith("__"):
+                continue
+            who = callers.get(name)
+            if who and who <= out:
+                out.add(name)
+                changed = True
+    return out
+
+
+def check_unguarded_shared_state(path: str, tree: ast.Module,
+                                 source_lines: Sequence[str],
+                                 graph: ModuleGraph,
+                                 namer: LockNamer) -> List[Finding]:
+    if _is_test_path(path):
+        return []
+    workers = _worker_methods(tree)
+    if not workers:
+        return []
+    findings: List[Finding] = []
+    for cls, methods in workers.items():
+        methods = _expand_worker_set(graph, cls, methods)
+        worker_infos = [i for i in graph.funcs.values()
+                        if i.cls == cls and
+                        i.qualname.split(".")[-1] in methods]
+        other_infos = [i for i in graph.funcs.values()
+                       if i.cls == cls and
+                       i.qualname.split(".")[-1] not in methods and
+                       i.qualname.split(".")[-1] != "__init__"]
+        other_sites = [(i, _attr_sites(i, namer)) for i in other_infos]
+        flagged: Set[str] = set()
+        for winfo in worker_infos:
+            wsites = _attr_sites(winfo, namer)
+            for attr, wwrites in sorted(wsites.writes.items()):
+                if attr in flagged:
+                    continue
+                if namer.attr_ctor(cls, attr) in THREADSAFE_CONSTRUCTORS:
+                    continue
+                for oinfo, osites in other_sites:
+                    owrites = osites.writes.get(attr, [])
+                    oreads = osites.reads.get(attr, [])
+                    if osites.first_join is not None:
+                        # accesses after a .join() are synchronized with
+                        # worker termination (happens-before) — the
+                        # _wait_drain error-handoff shape, not a race
+                        owrites = [(ln, g) for ln, g in owrites
+                                   if ln < osites.first_join]
+                        oreads = [(ln, g) for ln, g in oreads
+                                  if ln < osites.first_join]
+                    hit: Optional[Tuple[int, str]] = None
+                    # (a) write-write with no common lock
+                    for wline, wguard in wwrites:
+                        for oline, oguard in owrites:
+                            if not (wguard & oguard):
+                                hit = (wline,
+                                       f"also written in {oinfo.qualname} "
+                                       f"(line {oline}) with no common "
+                                       f"lock")
+                                break
+                        if hit:
+                            break
+                    # (b) worker writes bare while another site is guarded
+                    if hit is None:
+                        for wline, wguard in wwrites:
+                            if wguard:
+                                continue
+                            guarded = [(ln, g) for ln, g in
+                                       (owrites + oreads) if g]
+                            if guarded:
+                                oline, og = guarded[0]
+                                hit = (wline,
+                                       f"accessed in {oinfo.qualname} "
+                                       f"(line {oline}) under "
+                                       f"{sorted(og)[0]}, which this "
+                                       f"write does not hold")
+                                break
+                    if hit is None:
+                        continue
+                    line, detail = hit
+                    if is_suppressed(source_lines, line,
+                                     "unguarded-shared-state"):
+                        continue
+                    flagged.add(attr)
+                    findings.append(Finding(
+                        "unguarded-shared-state",
+                        f"self.{attr} is mutated in thread worker "
+                        f"{winfo.qualname} and {detail} — the interleaving "
+                        f"is a data race; guard both sites with one lock "
+                        f"(or confine the attribute to one thread)",
+                        path, line))
+                    break
+    return findings
+
+
+# -------------------------------------------- rule: thread-lifecycle
+
+
+def _daemon_true(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+            return bool(kw.value.value)
+    return False
+
+
+def check_thread_lifecycle(path: str, tree: ast.Module,
+                           source_lines: Sequence[str],
+                           graph: ModuleGraph,
+                           namer: LockNamer) -> List[Finding]:
+    if _is_test_path(path):
+        return []
+    findings: List[Finding] = []
+    # joins/daemon-marks per scope: class name -> names; plus per function
+    class_joined: Dict[str, Set[str]] = {}
+    class_daemoned: Dict[str, Set[str]] = {}
+    for info in graph.funcs.values():
+        scope = info.cls or ""
+        for node in ast.walk(info.node):
+            if isinstance(node, ast.Call) and \
+                    _terminal(node.func) == "join" and \
+                    isinstance(node.func, ast.Attribute):
+                d = _dotted(node.func.value)
+                if d:
+                    class_joined.setdefault(scope, set()).add(d)
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and \
+                            t.attr == "daemon" and \
+                            isinstance(node.value, ast.Constant) and \
+                            node.value.value:
+                        d = _dotted(t.value)
+                        if d:
+                            class_daemoned.setdefault(scope,
+                                                      set()).add(d)
+            if isinstance(node, ast.Call) and \
+                    _terminal(node.func) == "setDaemon" and \
+                    isinstance(node.func, ast.Attribute):
+                d = _dotted(node.func.value)
+                if d:
+                    class_daemoned.setdefault(scope, set()).add(d)
+
+    for info in graph.funcs.values():
+        scope = info.cls or ""
+        for node in ast.walk(info.node):
+            if not (isinstance(node, ast.Call)
+                    and _terminal(node.func) == "Thread"):
+                continue
+            root = _dotted(node.func) or ""
+            if root and root.split(".")[0] not in ("threading", "Thread"):
+                # SomeModule.Thread lookalikes: only the stdlib class
+                if "." in root:
+                    continue
+            if _daemon_true(node):
+                continue
+            # name(s) the constructed thread is bound to
+            bound: List[str] = []
+            parent_assign = None
+            for fn_node in ast.walk(info.node):
+                if isinstance(fn_node, ast.Assign) and any(
+                        node is c for c in ast.walk(fn_node.value)):
+                    parent_assign = fn_node
+                    break
+            if parent_assign is not None:
+                for t in parent_assign.targets:
+                    d = _dotted(t)
+                    if d:
+                        bound.append(d)
+            joined = class_joined.get(scope, set())
+            daemoned = class_daemoned.get(scope, set())
+            if not info.cls:
+                # module-level function: joins only visible in-function
+                joined = {d for d in joined}
+            if any(b in joined for b in bound):
+                continue
+            if any(b in daemoned for b in bound):
+                continue
+            if is_suppressed(source_lines, node.lineno,
+                             "thread-lifecycle"):
+                continue
+            what = (f"bound to {bound[0]}" if bound
+                    else "started fire-and-forget")
+            findings.append(Finding(
+                "thread-lifecycle",
+                f"{info.qualname} creates a non-daemon Thread ({what}) "
+                f"with no join() on any shutdown path and no daemon=True "
+                f"— process exit hangs waiting for it; mark it daemon or "
+                f"join it from stop()/close()",
+                path, node.lineno))
+    return findings
+
+
+# ------------------------------------------------------------- driver
+
+
+CHECKS = (
+    check_blocking_under_lock,
+    check_lock_order_cycle,
+    check_unguarded_shared_state,
+    check_thread_lifecycle,
+)
+
+
+def run_paths(paths: Sequence[str],
+              checkers: Optional[Sequence[str]] = None
+              ) -> Tuple[List[Finding], int]:
+    """Run the concurrency engine over files/dirs; (findings, files).
+
+    Same contract as the ast/protocol engines' run_paths; `checkers`
+    filters by rule id.
+    """
+    from .ast_engine import iter_python_files
+
+    wanted = set(checkers) if checkers else None
+    files = iter_python_files(paths)
+    findings: List[Finding] = []
+    for fpath in files:
+        try:
+            source = open(fpath).read()
+            tree = ast.parse(source)
+        except (OSError, SyntaxError) as e:
+            findings.append(Finding("parse-error", str(e), fpath, 0))
+            continue
+        lines = source.splitlines()
+        rel = os.path.relpath(fpath)
+        graph = ModuleGraph(tree)
+        namer = LockNamer(_class_attr_types(tree))
+        mark_concurrency_effects(graph, namer)
+        for check in CHECKS:
+            got = check(rel, tree, lines, graph, namer)
+            if wanted is not None:
+                got = [f for f in got if f.checker in wanted]
+            findings.extend(got)
+    return findings, len(files)

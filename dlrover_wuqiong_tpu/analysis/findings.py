@@ -227,6 +227,39 @@ RULE_CATALOG: Dict[str, Dict[str, str]] = {
                      "finally wedges the next worker generation for the "
                      "full timeout when this process dies mid-section",
     },
+    # ---- concurrency engine (lock discipline + shared-state races)
+    "blocking-under-lock": {
+        "engine": "concurrency", "severity": "error",
+        "rationale": "a socket dial/RPC/retry_call/fsync/sleep/subprocess "
+                     "spawn reachable inside a lock-held span turns a slow "
+                     "or dead peer into a wedge for every waiter — the PR 1 "
+                     "(SIGKILLed SharedLock holder, 600s SAVE_TIMEOUT "
+                     "stall) and PR 4 (replica dial-under-lock, 150s RPC "
+                     "floor) outage shape; copy under the lock, send after "
+                     "release",
+    },
+    "lock-order-cycle": {
+        "engine": "concurrency", "severity": "error",
+        "rationale": "lock A held while acquiring B adds ordering edge "
+                     "A->B; a cycle in the per-module edge graph means two "
+                     "threads entering from opposite ends deadlock — "
+                     "impose one global acquisition order",
+    },
+    "unguarded-shared-state": {
+        "engine": "concurrency", "severity": "error",
+        "rationale": "a self.X mutated in a Thread(target=self._run) "
+                     "worker and also written elsewhere with no common "
+                     "lock (or read under a lock the worker write does "
+                     "not hold) is a data race the GIL does not save you "
+                     "from",
+    },
+    "thread-lifecycle": {
+        "engine": "concurrency", "severity": "warning",
+        "rationale": "a non-daemon Thread started with no join() on any "
+                     "shutdown path hangs process exit — exactly how a "
+                     "'finished' job keeps its pod alive; mark it daemon "
+                     "or join it from stop()",
+    },
     # ---- jaxpr engine (trace-level)
     "collective-in-cond": {
         "engine": "jaxpr", "severity": "error",
@@ -275,3 +308,57 @@ RULE_CATALOG: Dict[str, Dict[str, str]] = {
 def catalog_json() -> Dict[str, Dict[str, str]]:
     """Stable-ordered catalog for ``--catalog`` and the schema test."""
     return {k: dict(RULE_CATALOG[k]) for k in sorted(RULE_CATALOG)}
+
+
+# ------------------------------------------------------------------ sarif
+
+
+def to_sarif(findings: List[Finding]) -> Dict:
+    """Serialize findings as a SARIF 2.1.0 document (``--format sarif``).
+
+    Rules render from RULE_CATALOG (the same single source of truth as
+    ``--catalog``/README) so CI annotations carry the rationale; findings
+    with no file anchor (jaxpr trace findings) omit the location.  Only
+    rules that actually fired are listed, keeping the document — and the
+    one-line stdout contract — small.
+    """
+    fired = sorted({f.checker for f in findings})
+    rules = []
+    for rid in fired:
+        entry = RULE_CATALOG.get(rid, {})
+        rules.append({
+            "id": rid,
+            "shortDescription": {"text": entry.get("rationale", rid)},
+            "properties": {"engine": entry.get("engine", "unknown")},
+            "defaultConfiguration": {
+                "level": entry.get("severity", "error")},
+        })
+    results = []
+    for f in findings:
+        res = {
+            "ruleId": f.checker,
+            "level": f.severity if f.severity in SEVERITIES else "error",
+            "message": {"text": f.message},
+        }
+        if f.path:
+            region = {"startLine": f.line} if f.line else {}
+            loc = {"physicalLocation": {
+                "artifactLocation": {"uri": f.path.replace("\\", "/")}}}
+            if region:
+                loc["physicalLocation"]["region"] = region
+            res["locations"] = [loc]
+        results.append(res)
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftlint",
+                "informationUri":
+                    "https://github.com/intelligent-machine-learning/"
+                    "dlrover",
+                "rules": rules,
+            }},
+            "results": results,
+        }],
+    }

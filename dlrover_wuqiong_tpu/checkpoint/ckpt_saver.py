@@ -356,7 +356,11 @@ class AsyncCheckpointSaver:
             except Exception:  # noqa: BLE001 — degraded: stream unlocked
                 acquired = False
         try:
-            return self._save_shard_locked(handler, step, sdir, local_rank)
+            # stream-while-locked IS the design: the shm SharedLock must
+            # cover the disk stream or an engine drain overwrites the
+            # payload mid-save (torn shard under a done-file); the dead-pid
+            # reaper bounds a holder's crash.
+            return self._save_shard_locked(handler, step, sdir, local_rank)  # graftlint: disable=blocking-under-lock -- shm lock must span the verified stream to storage; see comment above
         finally:
             if acquired:
                 try:

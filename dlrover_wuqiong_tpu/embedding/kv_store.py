@@ -60,7 +60,7 @@ def _build_lib() -> Optional[ctypes.CDLL]:
             try:
                 cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
                        "-pthread", _SRC, "-o", so]
-                subprocess.run(cmd, check=True, capture_output=True,
+                subprocess.run(cmd, check=True, capture_output=True,  # graftlint: disable=blocking-under-lock -- one-time double-checked build: waiting for the single g++ compile under _LIB_LOCK is the point
                                timeout=120)
                 _LIB_CACHE = _load(so)
                 logger.info("built native kv_store: %s", so)

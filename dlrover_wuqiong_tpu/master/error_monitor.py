@@ -60,6 +60,22 @@ _FINAL_EXC = re.compile(r"^\w*(Error|Exception)\b")
 #: and coordinator blips are exactly what elasticity exists to survive)
 TRANSIENT_CLASSES = {"unknown", "preempted", "network"}
 
+# exit_code=137 is ambiguous: the kernel OOM-killer and a preemption
+# SIGKILL both exit 137.  "Bare" 137 evidence (no explicit memory text)
+# can be disambiguated by the policy engine's preemption-rate estimate —
+# during a kill storm the prior says preemption, and misclassifying it
+# host_oom lets the repeated-class cutoff stop a rank that elasticity
+# should keep relaunching (ROADMAP item 2).
+_EXIT_137 = re.compile(r"exit_code=137", re.I)
+_EXPLICIT_OOM = re.compile(
+    r"MemoryError|oom[-_ ]?kill|Cannot allocate memory|out of memory",
+    re.I)
+
+#: MTBF at or below this is a high-preemption regime (matches
+#: brain/policy.py PolicyConfig.warm_mtbf_s — the tier where the policy
+#: engine already keeps a warm pool hot because kills are routine).
+PREEMPT_REGIME_MTBF_S = 600.0
+
 
 def classify_error(error_data: str) -> Tuple[str, str, bool]:
     """(error class, NodeExitReason, relaunchable) for an error payload.
@@ -92,10 +108,35 @@ class ErrorMonitor:
     whether the class allows relaunch.
     """
 
-    def __init__(self):
+    def __init__(self, preemption_rate_fn=None,
+                 preemption_mtbf_cutoff_s: float = PREEMPT_REGIME_MTBF_S):
         self._lock = threading.Lock()
         # rank -> [(pod/node id, restart_count, class, error_data)]
         self._history: Dict[int, List[Tuple[int, int, str, str]]] = {}
+        # optional hook to the policy engine's EWMA preemption estimator
+        # (brain/policy.py PreemptionRateEstimator.rate_per_s) — None
+        # keeps the estimator-free catalogue behavior unchanged
+        self._preempt_rate_fn = preemption_rate_fn
+        self._preempt_mtbf_cutoff_s = preemption_mtbf_cutoff_s
+
+    def bind_preemption_estimator(self, rate_fn,
+                                  mtbf_cutoff_s: Optional[float] = None):
+        """Wire the policy engine's preemption-rate estimate in after
+        construction (JobMaster builds the monitor before the engine)."""
+        self._preempt_rate_fn = rate_fn
+        if mtbf_cutoff_s is not None:
+            self._preempt_mtbf_cutoff_s = mtbf_cutoff_s
+
+    def _preemption_regime(self) -> bool:
+        """True when the estimated kill MTBF is at/below the cutoff."""
+        fn = self._preempt_rate_fn
+        if fn is None:
+            return False
+        try:
+            rate = float(fn())
+        except Exception:  # noqa: BLE001 — estimator trouble = no prior
+            return False
+        return rate > 0.0 and (1.0 / rate) <= self._preempt_mtbf_cutoff_s
 
     def process_error(self, rank: int, restart_count: int,
                       error_data: str, level: str = "process",
@@ -108,6 +149,19 @@ class ErrorMonitor:
         to the rank's history (that recurrence is exactly what
         `repeated_class` must see)."""
         cls, reason, relaunch = classify_error(error_data)
+        if cls == "host_oom":
+            text = error_data or ""
+            if _EXIT_137.search(text) and not _EXPLICIT_OOM.search(text) \
+                    and self._preemption_regime():
+                # bare 137 during a kill storm: the rate prior says this
+                # SIGKILL is a preemption, not the OOM-killer — keep it
+                # TRANSIENT so the repeated-class cutoff never stops a
+                # rank the scheduler is churning
+                cls, reason, relaunch = ("preempted",
+                                         NodeExitReason.KILLED, True)
+                logger.info("rank %s: bare exit_code=137 reclassified as "
+                            "preemption (estimated MTBF <= %.0fs)", rank,
+                            self._preempt_mtbf_cutoff_s)
         nid = node_id if node_id is not None else rank
         with self._lock:
             hist = self._history.setdefault(rank, [])

@@ -98,6 +98,14 @@ class JobMaster:
         # so the decision log replays identically across a master restart
         # even though the engine's rate estimator restarts cold.
         self.policy_engine = policy_engine
+        if policy_engine is not None:
+            # let the error catalogue consult the EWMA preemption rate:
+            # a bare exit_code=137 during a kill storm classifies as
+            # preemption (TRANSIENT), not host_oom, so the repeated-class
+            # cutoff no longer depends on relaunch_always to keep a
+            # churned rank alive (master/error_monitor.py)
+            self.job_manager.error_monitor.bind_preemption_estimator(
+                policy_engine.estimator.rate_per_s)
         self._policy_decisions: list = []
         self._policy_seq = 0
         # ------------------------------------------------- fault tolerance

@@ -19,3 +19,52 @@ os.environ.setdefault("DWT_SOCKET_DIR", "/tmp/dwt-test/sockets")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import threading  # noqa: E402
+
+import pytest  # noqa: E402
+
+#: thread-name prefixes tests may legitimately leave running: pytest/
+#: plugin internals plus library pools that outlive a single test by
+#: design (jax/XLA dispatch pools, concurrent.futures executors are
+#: daemonic or process-lifetime and excluded by the daemon check anyway).
+_THREAD_ALLOWLIST_PREFIXES = (
+    "MainThread", "pydevd.", "ThreadPoolExecutor",
+)
+
+
+def _nondaemon_threads():
+    return {
+        t for t in threading.enumerate()
+        if t.is_alive() and not t.daemon
+        and not t.name.startswith(_THREAD_ALLOWLIST_PREFIXES)
+    }
+
+
+@pytest.fixture(autouse=True)
+def _thread_leak_guard(request):
+    """Fail any test that leaks a non-daemon thread.
+
+    A leaked non-daemon thread hangs interpreter exit — exactly the
+    thread-lifecycle wedge graftlint's concurrency engine flags in
+    product code; this guard enforces the same discipline on test
+    scaffolding.  Pre-existing survivors (leaked by an EARLIER test)
+    are baselined out so one leaker doesn't cascade failures; a short
+    join grace absorbs threads that are mid-shutdown when the test
+    body returns."""
+    before = _nondaemon_threads()
+    yield
+    leaked = _nondaemon_threads() - before
+    if not leaked:
+        return
+    deadline = 1.0 / max(len(leaked), 1)
+    for t in leaked:
+        t.join(timeout=deadline)
+    leaked = {t for t in leaked if t.is_alive()}
+    if leaked:
+        names = sorted(f"{t.name} (target={getattr(t, '_target', None)})"
+                       for t in leaked)
+        pytest.fail(
+            f"test leaked non-daemon thread(s): {names} — join them or "
+            f"mark them daemon (see graftlint thread-lifecycle)",
+            pytrace=False)

@@ -417,7 +417,8 @@ class TestRuleCatalog:
     def test_every_emitted_checker_is_cataloged(self):
         # engines may only emit rule ids the catalog documents
         for rule_id, entry in RULE_CATALOG.items():
-            assert entry["engine"] in ("ast", "protocol", "jaxpr", "hlo")
+            assert entry["engine"] in ("ast", "protocol", "concurrency",
+                                       "jaxpr", "hlo")
             assert entry["severity"] in ("error", "warning")
             assert len(entry["rationale"]) > 20
 
@@ -495,7 +496,8 @@ class TestCliV2:
         out = capsys.readouterr().out.strip()
         rec = json.loads(out)["graftlint"]
         assert rc == 0
-        assert rec["engines"] == ["ast", "protocol"]  # no jaxpr/hlo
+        assert rec["engines"] == ["ast", "protocol",
+                                  "concurrency"]  # no jaxpr/hlo
 
     def test_changed_paths_smoke(self):
         from dlrover_wuqiong_tpu.analysis.__main__ import _changed_paths

@@ -189,6 +189,93 @@ class TestClassify:
         assert em.repeated_class(2) == "hardware"
 
 
+class TestPreemptionDisambiguation:
+    """exit_code=137 is ambiguous (OOM-killer and preemption SIGKILL both
+    exit 137).  With the policy engine's rate estimate bound, a BARE 137
+    during a high-preemption regime classifies as preemption — TRANSIENT —
+    so the repeated-class cutoff no longer depends on relaunch_always to
+    keep a kill-stormed rank alive (ROADMAP item 2 leftover)."""
+
+    def test_no_estimator_keeps_catalog_behavior(self):
+        em = ErrorMonitor()
+        reason, relaunch = em.process_error(0, 0, "worker exit_code=137")
+        assert em.error_class_history(0) == [(0, "host_oom")]
+        assert reason == NodeExitReason.OOM and relaunch is True
+
+    def test_low_rate_regime_stays_host_oom(self):
+        # MTBF 3600s (one kill/hour) is NOT a storm: trust the OOM prior
+        em = ErrorMonitor(preemption_rate_fn=lambda: 1.0 / 3600.0)
+        em.process_error(0, 0, "worker exit_code=137")
+        assert em.error_class_history(0) == [(0, "host_oom")]
+
+    def test_kill_storm_reclassifies_bare_137(self):
+        # MTBF 60s: the regime prior says SIGKILL = preemption
+        em = ErrorMonitor(preemption_rate_fn=lambda: 1.0 / 60.0)
+        reason, relaunch = em.process_error(0, 0, "worker exit_code=137")
+        assert em.error_class_history(0) == [(0, "preempted")]
+        assert reason == NodeExitReason.KILLED and relaunch is True
+
+    def test_storm_of_137s_never_triggers_cutoff(self):
+        # the point of the satellite: a kill storm of bare 137s used to
+        # build a host_oom streak and trip repeated_class — now it stays
+        # TRANSIENT and the rank keeps its relaunch budget
+        em = ErrorMonitor(preemption_rate_fn=lambda: 1.0 / 60.0)
+        for pod in range(5):
+            em.process_error(3, 0, "worker exit_code=137", node_id=pod)
+        assert em.repeated_class(3) is None
+        assert em.repeated_class(3, min_repeats=2) is None
+
+    def test_explicit_oom_evidence_beats_the_regime_prior(self):
+        # "oom-killed" text is direct evidence — regime or not, it's OOM
+        em = ErrorMonitor(preemption_rate_fn=lambda: 1.0 / 60.0)
+        em.process_error(0, 0, "exit_code=137 container oom-killed")
+        assert em.error_class_history(0) == [(0, "host_oom")]
+
+    def test_estimator_failure_degrades_to_catalog(self):
+        def boom():
+            raise RuntimeError("estimator gone")
+
+        em = ErrorMonitor(preemption_rate_fn=boom)
+        em.process_error(0, 0, "worker exit_code=137")
+        assert em.error_class_history(0) == [(0, "host_oom")]
+
+    def test_bind_after_construction_with_cutoff(self):
+        em = ErrorMonitor()
+        em.bind_preemption_estimator(lambda: 1.0 / 60.0,
+                                     mtbf_cutoff_s=30.0)
+        # MTBF 60s but cutoff tightened to 30s → not a storm
+        em.process_error(0, 0, "worker exit_code=137")
+        assert em.error_class_history(0) == [(0, "host_oom")]
+
+    def test_real_estimator_end_to_end(self):
+        """Drive the actual EWMA estimator into a storm regime and watch
+        the catalogue flip: the same payload classifies host_oom cold and
+        preempted hot."""
+        from dlrover_wuqiong_tpu.brain.policy import (
+            PreemptionRateEstimator)
+
+        t = [0.0]
+        est = PreemptionRateEstimator(tau_s=600.0, clock=lambda: t[0])
+        em = ErrorMonitor(preemption_rate_fn=lambda: est.rate_per_s(t[0]))
+        em.process_error(0, 0, "worker exit_code=137", node_id=0)
+        assert em.error_class_history(0) == [(0, "host_oom")]
+        for _ in range(6):  # a kill a minute
+            t[0] += 60.0
+            est.record(t[0])
+        em.process_error(0, 0, "worker exit_code=137", node_id=1)
+        assert em.error_class_history(0)[-1] == (0, "preempted")
+
+    def test_master_binds_policy_estimator(self):
+        from dlrover_wuqiong_tpu.brain.policy import PolicyEngine
+        from dlrover_wuqiong_tpu.master.master import JobMaster
+
+        engine = PolicyEngine()
+        master = JobMaster(min_nodes=1, max_nodes=1,
+                           policy_engine=engine)
+        em = master.job_manager.error_monitor
+        assert em._preempt_rate_fn == engine.estimator.rate_per_s
+
+
 class TestIsOomError:
     def test_narrowed_heuristic(self):
         class XlaRuntimeError(Exception):
