@@ -239,6 +239,16 @@ def _main():
         side.update(fused_report)
     except Exception as e:  # noqa: BLE001
         side["fused_error"] = repr(e)[:300]
+
+    # serving: continuous batching vs one-request-at-a-time on the same
+    # engine (ISSUE 11 tentpole) — slot-parallel decode windows must beat
+    # sequential decode, and the latency tails ride the headline line
+    serve_report = {}
+    try:
+        serve_report = _serving_run()
+        side.update(serve_report)
+    except Exception as e:  # noqa: BLE001
+        side["serve_error"] = repr(e)[:300]
     flops_per_token = None
     if n_params:
         side["params"] = n_params
@@ -381,6 +391,12 @@ def _main():
         line.update({k: fused_report[k] for k in
                      ("fused_tokens_per_s", "fused_steps",
                       "perstep_driver_tokens_per_s", "fused_vs_perstep")})
+    if serve_report:
+        # add-only serving keys: decode throughput, latency tails and the
+        # continuous-batching win over sequential decode
+        line.update({k: serve_report[k] for k in
+                     ("serve_tokens_per_s", "serve_p50_ms",
+                      "serve_p99_ms", "serve_vs_sequential")})
     # goodput split for the bench process itself: compile vs productive
     # vs checkpoint states (credited by the engine) — side experiments
     # land in other_s by design
@@ -476,6 +492,65 @@ def _fused_vs_perstep(res, cfg, batch, seq, state):
         "perstep_driver_tokens_per_s": round(batch * seq / per_step_s, 1),
         "fused_tokens_per_s": round(batch * seq / fused_step_s, 1),
         "fused_vs_perstep": round(per_step_s / fused_step_s, 3),
+    }
+
+
+def _serving_run(n: int = 16, max_new: int = 24):
+    """Continuous batching vs one-request-at-a-time, SAME engine.
+
+    Both paths run the identical compiled admit/decode programs (warmed
+    once, outside the timed windows) on the identical requests, so the
+    ratio isolates what in-flight batching buys: a decode window prices
+    one dispatch for `max_slots` rows, and the sequential baseline wastes
+    `max_slots - 1` of them.  Latency tails come from the serving
+    ledger's per-request reservoir (telemetry/serving.py) over the
+    continuous run — queueing delay included, which is the number a
+    serving SLO actually sees."""
+    from dlrover_wuqiong_tpu.models.gpt import GPT, GPTConfig
+    from dlrover_wuqiong_tpu.serving import (
+        LocalServer,
+        ServeSpec,
+        ServingEngine,
+    )
+    from dlrover_wuqiong_tpu.telemetry.serving import reset_serve_ledger
+
+    cfg = GPTConfig.nano()
+    params = GPT(cfg).init_params(jax.random.PRNGKey(0))
+    spec = ServeSpec(max_slots=4, max_len=64, max_prompt_len=8,
+                     fused_tokens=4)
+    eng = ServingEngine(cfg, params, spec)
+    prompts = [[1 + i, 7, 13][:2 + i % 2] for i in range(n)]
+
+    def run_batched(tag, ids):
+        srv = LocalServer(eng)
+        for i in ids:
+            srv.submit(f"{tag}-{i}", prompts[i], max_new_tokens=max_new,
+                       seed=i)
+        return srv.drain()
+
+    run_batched("warm", [0, 1])  # compile admit + decode, untimed
+
+    t0 = time.perf_counter()
+    for i in range(n):
+        run_batched("seq", [i])  # one request owns the whole engine
+    seq_dt = time.perf_counter() - t0
+
+    led = reset_serve_ledger()
+    led.start()
+    t0 = time.perf_counter()
+    run_batched("cb", list(range(n)))
+    cont_dt = time.perf_counter() - t0
+    lat = led.snapshot()["latency"]
+    total = n * max_new
+    return {
+        "serve_tokens_per_s": round(total / cont_dt, 1),
+        "serve_p50_ms": round(lat["p50_ms"], 2),
+        "serve_p99_ms": round(lat["p99_ms"], 2),
+        "serve_vs_sequential": round(seq_dt / cont_dt, 3),
+        "serve_sequential_tokens_per_s": round(total / seq_dt, 1),
+        "serve_requests": n,
+        "serve_max_new_tokens": max_new,
+        "serve_slots": spec.max_slots,
     }
 
 

@@ -399,6 +399,73 @@ class MasterClient:
             "get", msg.PolicyHistoryRequest(node_id=self.node_id))
         return json.loads(resp.content) if resp.content else []
 
+    # ------------------------------------------------------------- serving
+
+    def submit_serve_requests(self, requests: List[msg.ServeRequest]
+                              ) -> msg.ServeSubmitAck:
+        """Enqueue inference requests — CRITICAL + idem: the master
+        journals before acking, and a retry crossing a restart replays
+        the ack instead of double-enqueueing."""
+        return self._call_critical(
+            "report",
+            msg.ServeSubmitRequest(node_id=self.node_id,
+                                   requests=list(requests)),
+            idem=self._next_idem())
+
+    def lease_serve_requests(self, max_requests: int = 1
+                             ) -> List[msg.ServeRequest]:
+        """Lease pending requests for this decode worker — CRITICAL +
+        idem (like get_task: a retried lease must return the SAME
+        requests or they strand in `leased`)."""
+        resp = self._call_critical(
+            "get",
+            msg.ServeLeaseRequest(node_id=self.node_id,
+                                  max_requests=max_requests),
+            idem=self._next_idem())
+        return list(resp.requests)
+
+    def report_serve_results(self, results: List[msg.ServeResult]):
+        """Durable result hand-off — CRITICAL + idem (drain correctness:
+        the worker may exit only after this ack)."""
+        return self._call_critical(
+            "report",
+            msg.ServeResultReport(node_id=self.node_id,
+                                  results=list(results)),
+            idem=self._next_idem())
+
+    def get_serve_results(self, request_ids: List[str]
+                          ) -> msg.ServeResultResponse:
+        """Poll for finished results (fail fast; the client's next poll
+        is the retry — re-delivery is deduped by request_id)."""
+        return self._call_polling(
+            "get", msg.ServeResultQuery(request_ids=list(request_ids)))
+
+    def report_serve_stats(self, snapshot: Dict, active_slots: int = 0):
+        """Push a cumulative serving-ledger snapshot (telemetry/serving
+        ``ServeLedger.snapshot()``) — BUFFERED like the goodput ledger:
+        cumulative totals make drops/replays harmless."""
+        lat = snapshot.get("latency", {})
+        return self._call_buffered(
+            msg.ServeStatsReport(
+                node_id=self.node_id,
+                wall_s=float(snapshot.get("wall_s", 0.0)),
+                states={str(k): float(v)
+                        for k, v in snapshot.get("states", {}).items()},
+                counters={str(k): int(v)
+                          for k, v in snapshot.get("counters",
+                                                   {}).items()},
+                active_slots=int(active_slots),
+                p50_ms=float(lat.get("p50_ms", 0.0)),
+                p99_ms=float(lat.get("p99_ms", 0.0)),
+                ttft_p50_ms=float(lat.get("ttft_p50_ms", 0.0)),
+                ttft_p99_ms=float(lat.get("ttft_p99_ms", 0.0)),
+                sent_at=time.time()),
+            default=msg.OkResponse())
+
+    def get_serve_summary(self) -> msg.ServeSummary:
+        """Job-level serving aggregation (tools/serve_report.py)."""
+        return self._call_polling("get", msg.ServeStatsQuery())
+
     def report_diagnosis(self, payload_type: str,
                          content: str) -> msg.DiagnosisAction:
         return self._call_buffered(msg.DiagnosisReport(

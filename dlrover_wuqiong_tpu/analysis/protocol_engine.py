@@ -67,6 +67,7 @@ JOURNALED_VERBS = {
     "TaskRequest", "KVStoreAddRequest", "JoinRendezvousRequest",
     "TaskResult", "DatasetShardParams", "NodeMeta", "NodeFailure",
     "KVStoreSetRequest", "ShardCheckpoint", "PolicyDecisionReport",
+    "ServeSubmitRequest", "ServeLeaseRequest", "ServeResultReport",
 }
 
 #: verbs that are NOT naturally idempotent across a master restart: the
@@ -74,6 +75,7 @@ JOURNALED_VERBS = {
 IDEM_VERBS = {
     "TaskRequest", "KVStoreAddRequest", "JoinRendezvousRequest",
     "TaskResult", "PolicyDecisionReport",
+    "ServeSubmitRequest", "ServeLeaseRequest", "ServeResultReport",
 }
 
 #: names whose (transitive) call means "a manifest was published".

@@ -80,6 +80,12 @@ class WarmSpec:
     # at the wrong K is a cache MISS for the restarted worker — the spec
     # must carry it.
     fused_steps: int = 1
+    # ADD-ONLY: when set, this spec warms the SERVING executables (admit
+    # + fused decode window) instead of a train step — a dict of
+    # serving.ServeSpec fields (slot count / max_len / fused_tokens /
+    # quant are all in the serving compile-cache key, so a replacement
+    # decode worker after `chaos serve-drain` finds its programs warm).
+    serve: Optional[Dict] = None
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), sort_keys=True)
@@ -465,6 +471,40 @@ def _child_main(spec_path: str) -> int:
         import optax
 
         from .accelerate import auto_accelerate
+
+        if getattr(spec, "serve", None):
+            # serving warm: materialized on purpose — the engine's admit
+            # and decode programs must actually RUN once to land in the
+            # persistent cache, and a decode-mesh model is small next to
+            # a training world (no optimizer state, no activations)
+            from ..serving.engine import ServeSpec, ServingEngine
+
+            model = build_model(spec.model)
+            sspec = ServeSpec(**spec.serve)
+            params = model.init_params(jax.random.PRNGKey(0))
+            eng = ServingEngine(model.config, params, sspec,
+                                cache_dir=cache_dir)
+            with tspans.span("warm:serve", {"spec": skey,
+                                            "slots": sspec.max_slots}):
+                eng.admit(0, [1], 0)
+                eng.decode_window()
+                eng.retire(0)
+            entry = {
+                "spec_key": skey,
+                "cache_key": eng.cache_key,
+                "n_devices": spec.n_devices,
+                "serve": dict(spec.serve),
+                "platform": spec.platform,
+                "compile_s": round(time.monotonic() - t0, 2),
+                "ready": True,
+                "ts": time.time(),
+            }
+            tmp = os.path.join(pool, f".{eng.cache_key}.{os.getpid()}")
+            with open(tmp, "w") as f:
+                json.dump(entry, f)
+            os.replace(tmp, os.path.join(pool, f"{eng.cache_key}.json"))
+            print(json.dumps(entry), flush=True)
+            return 0
 
         model = build_model(spec.model)
         devices = jax.devices()[:spec.n_devices]

@@ -14,6 +14,7 @@ it is equally a CI test body (tests/test_chaos.py) and an operator tool:
     python -m dlrover_wuqiong_tpu.chaos preempt-warm   # re-mesh compile win
     python -m dlrover_wuqiong_tpu.chaos preempt-fused  # K-step boundaries
     python -m dlrover_wuqiong_tpu.chaos preempt-adaptive  # policy loop
+    python -m dlrover_wuqiong_tpu.chaos serve-drain    # kill decode worker
 
 pod-kill drives the REAL stack — `run` CLI → master → agent → worker with
 flash checkpoints — and hard-SIGKILLs the worker process group externally
@@ -1940,6 +1941,232 @@ def master_kill(dataset_size: int = 576, batch: int = 4,
             report["workdir"] = work
 
 
+def serve_drain(n_requests: int = 8, max_new_tokens: int = 24,
+                kill_after_done: int = 2, timeout: float = 300.0) -> Dict:
+    """SIGKILL a decode WORKER mid-traffic; drain to a replacement.
+
+    The serving subsystem's headline invariant: in-flight inference
+    requests survive the death of the worker decoding them.  The drill
+    runs a journaled standalone master, submits a batch of requests,
+    starts a real `python -m dlrover_wuqiong_tpu.serving` worker,
+    SIGKILLs it while some requests are done and others are mid-decode,
+    reports the failure (the production attribution path is the
+    heartbeat sweep; the drill reports explicitly, like the reference's
+    chaosblade harness), starts a SECOND worker and drains.  Invariants:
+
+    - zero dropped: every request gets a result with exactly
+      `max_new_tokens` tokens despite the kill;
+    - bit-identical: results equal an alone-decode of the same
+      (weights, prompt, seed) on a fresh local engine with DIFFERENT
+      batch geometry — re-admitted requests restart from the prompt
+      (never a corrupt half-state) and the position-keyed sampler
+      (serving/engine.py) makes the replay exact;
+    - recovery is ATTRIBUTED: `requeued_total` > 0 in the serve summary
+      and surfaces under the pinned `requeued` ledger counter;
+    - one trace tree per request reconstructs from the flight dumps of
+      BOTH worker generations (trace ids derive from request ids,
+      serving/scheduler.request_trace_id) with admit + finish events.
+    """
+    from .agent.master_client import MasterClient
+    from .common import messages as msg
+    from .common.comm import addr_connectable, find_free_port
+    from .serving.scheduler import request_trace_id
+    from .telemetry.recorder import load_flight_dumps
+
+    work = tempfile.mkdtemp(prefix="dwt-chaos-servedrain-")
+    journal_dir = os.path.join(work, "journal")
+    # ONE flight-dump dir shared by both worker generations: the trace
+    # reconstruction must join spans across the kill
+    ckpt_dir = os.path.join(work, "ckpt")
+    os.makedirs(ckpt_dir)
+    global _launch_seq
+    _launch_seq += 1
+    job = f"servedrain{os.getpid()}n{_launch_seq}"
+    port = find_free_port()
+    addr = f"127.0.0.1:{port}"
+    env = dict(
+        os.environ, DWT_JOB_NAME=job, JAX_PLATFORMS="cpu",
+        DWT_SOCKET_DIR=os.path.join(work, "sockets"),
+        PYTHONPATH=os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))) + os.pathsep +
+        os.environ.get("PYTHONPATH", ""))
+
+    def spawn_master():
+        return subprocess.Popen(
+            [sys.executable, "-m", "dlrover_wuqiong_tpu.master",
+             f"--port={port}", "--min_nodes=1", "--max_nodes=1",
+             f"--journal-dir={journal_dir}", "--poll-interval=0.5"],
+            env=env, cwd=work, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+
+    def spawn_worker(node_id: int):
+        return subprocess.Popen(
+            [sys.executable, "-m", "dlrover_wuqiong_tpu.serving",
+             "--master", addr, "--node-id", str(node_id),
+             "--slots", "2", "--max-len", "64", "--max-prompt-len", "8",
+             "--fused-tokens", "2", "--stats-every", "1",
+             "--model-seed", "0", "--ckpt-dir", ckpt_dir],
+            env=env, cwd=work, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+
+    report: Dict = {"scenario": "serve-drain", "requests": n_requests,
+                    "max_new_tokens": max_new_tokens}
+    master = spawn_master()
+    w1 = w2 = None
+    cli = None
+    try:
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and not addr_connectable(addr):
+            time.sleep(0.1)
+        if not addr_connectable(addr):
+            report.update(ok=False, error="master never came up")
+            return report
+        cli = MasterClient(addr, node_id=90, node_type="chaos")
+        reqs = [msg.ServeRequest(
+                    request_id=f"req-{i:02d}",
+                    prompt=[1 + i, 7, 13, 2 + i][:3 + i % 2],
+                    max_new_tokens=max_new_tokens, temperature=1.0,
+                    seed=1000 + i, submitted_at=time.time())
+                for i in range(n_requests)]
+        report["accepted"] = cli.submit_serve_requests(reqs).accepted
+
+        w1 = spawn_worker(1)
+        # wait for MID-TRAFFIC: some requests done AND some leased (the
+        # kill must land on held leases, or there is nothing to recover)
+        deadline = time.monotonic() + timeout / 2
+        done_at_kill = -1
+        while time.monotonic() < deadline and w1.poll() is None:
+            summ = cli.get_serve_summary()
+            if summ.done_total >= kill_after_done and summ.leased > 0:
+                done_at_kill = summ.done_total
+                break
+            time.sleep(0.05)
+        report["done_at_kill"] = done_at_kill
+        if not (0 <= done_at_kill < n_requests):
+            report.update(ok=False, w1_rc=w1.poll(),
+                          error="never reached mid-traffic kill point")
+            return report
+        w1.kill()  # SIGKILL — admitted requests die with their slots
+        w1.wait(timeout=10)
+        logger.info("serve-drain: SIGKILLed worker pid=%d at done=%d",
+                    w1.pid, done_at_kill)
+        failed_cli = MasterClient(addr, node_id=1,
+                                  node_type="serve-worker")
+        try:
+            failed_cli.report_failure("chaos serve-drain SIGKILL",
+                                      level="process")
+        finally:
+            failed_cli.close()
+
+        w2 = spawn_worker(2)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if cli.get_serve_summary().done_total >= n_requests:
+                break
+            time.sleep(0.1)
+        resp = cli.get_serve_results([r.request_id for r in reqs])
+        got = {r.request_id: [int(t) for t in r.tokens]
+               for r in resp.results}
+        summ = cli.get_serve_summary()
+        report["results"] = len(got)
+        report["requeued_total"] = summ.requeued_total
+        report["requeued_counter"] = int(
+            summ.counters.get("requeued", 0))
+        report["zero_dropped"] = bool(
+            len(got) == n_requests
+            and all(len(t) == max_new_tokens for t in got.values()))
+
+        # bit-identical replay: alone-decode on a fresh local engine
+        # with DIFFERENT batch geometry (composition must not matter)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from .models.gpt import GPT, GPTConfig
+        from .serving import LocalServer, ServeSpec, ServingEngine
+
+        cfg = GPTConfig.nano()
+        params = GPT(cfg).init_params(jax.random.PRNGKey(0))
+        srv = LocalServer(ServingEngine(cfg, params, ServeSpec(
+            max_slots=3, max_len=64, max_prompt_len=8, fused_tokens=4)))
+        for r in reqs:
+            srv.submit(r.request_id, list(r.prompt),
+                       max_new_tokens=r.max_new_tokens, seed=r.seed,
+                       temperature=r.temperature)
+        expected = srv.drain()
+        mismatched = [rid for rid in expected
+                      if got.get(rid) != expected[rid]]
+        report["bit_identical"] = not mismatched
+        if mismatched:
+            report["mismatched"] = mismatched[:4]
+
+        # one trace tree per request, reconstructed from flight dumps
+        dumps = load_flight_dumps(ckpt_dir)
+        report["flight_dumps"] = len(dumps)
+        seen = set()  # (trace, span) — the ring re-flushes cumulatively
+        names_by_trace: Dict = {}
+        pids_by_trace: Dict = {}
+        for d in dumps:
+            for evt in d.get("events", []):
+                if evt.get("kind") != "span":
+                    continue
+                rec = evt.get("data", {})
+                key = (rec.get("trace_id", ""), rec.get("span_id", ""))
+                if key in seen:
+                    continue
+                seen.add(key)
+                tid = rec.get("trace_id", "")
+                names_by_trace.setdefault(tid, set()).add(
+                    rec.get("name", ""))
+                pids_by_trace.setdefault(tid, set()).add(rec.get("pid"))
+        trees_ok = True
+        cross_generation = 0
+        for r in reqs:
+            tid = request_trace_id(r.request_id)
+            if not {"serve:admit", "serve:finish"} <= \
+                    names_by_trace.get(tid, set()):
+                trees_ok = False
+            if len(pids_by_trace.get(tid, set())) > 1:
+                cross_generation += 1
+        report["trace_trees_complete"] = trees_ok
+        # requests admitted by gen-1 and re-admitted by gen-2 join one
+        # tree with spans from two pids (informational: lease timing
+        # decides whether a killed request was already admitted)
+        report["trace_trees_cross_generation"] = cross_generation
+
+        report["ok"] = bool(
+            report["zero_dropped"] and report["bit_identical"]
+            and report["requeued_total"] > 0
+            and report["requeued_counter"] > 0 and trees_ok)
+        return report
+    finally:
+        tails = {}
+        for name, p in (("w1", w1), ("w2", w2)):
+            if p is None:
+                continue
+            if p.poll() is None:
+                p.kill()
+            try:
+                out, _ = p.communicate(timeout=10)
+            except (subprocess.TimeoutExpired, ValueError):
+                out = ""
+            tails[name] = (out or "")[-2000:]
+        if cli is not None:
+            cli.close()
+        if master.poll() is None:
+            master.terminate()
+            try:
+                master.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                master.kill()
+        if report.get("ok"):
+            import shutil
+
+            shutil.rmtree(work, ignore_errors=True)
+        else:
+            report.update(workdir=work, **{f"{k}_tail": v
+                                           for k, v in tails.items()})
+
+
 SCENARIOS = {"pod-kill": pod_kill, "straggler": straggler,
              "network-partition": network_partition,
              "preempt": preempt, "preempt-table": preempt_table,
@@ -1947,7 +2174,8 @@ SCENARIOS = {"pod-kill": pod_kill, "straggler": straggler,
              "preempt-fused": preempt_fused,
              "preempt-adaptive": preempt_adaptive,
              "ckpt-corrupt": ckpt_corrupt,
-             "master-kill": master_kill}
+             "master-kill": master_kill,
+             "serve-drain": serve_drain}
 
 
 def main(argv=None):

@@ -69,6 +69,10 @@ class JobMaster:
                 .set_world_size_policy(
                     self.job_manager.make_warm_mesh_policy())
         self.kv_store = KVStoreService()
+        # serving admission queue (serving/): journaled like task shards
+        from .serve_queue import ServeQueueManager
+
+        self.serve_queue = ServeQueueManager()
         # uniform failure cleanup regardless of which monitor detected it
         # (watcher event, heartbeat sweep, or explicit failure report) —
         # parity: reference event_callback.py wiring at dist_master.py:195
@@ -77,6 +81,7 @@ class JobMaster:
         class _CleanupCallback(NodeEventCallback):
             def on_node_failed(self, node):
                 master.task_manager.recover_tasks(node.id)
+                master.serve_queue.recover_node(node.id)
                 for rdzv in master.rdzv_managers.values():
                     rdzv.remove_alive_node(node.id)
                 master.speed_monitor.remove_running_worker(node.id)
@@ -218,6 +223,8 @@ class JobMaster:
             self.idem_cache.restore_state(state["idem"])
         for decision in state.get("policy") or []:
             self._apply_policy(decision)
+        if state.get("serve"):
+            self.serve_queue.restore_state(state["serve"])
 
     def _apply_entry(self, kind: str, data: Dict):
         data = dict(data)
@@ -234,6 +241,7 @@ class JobMaster:
                 data["dataset_name"], data["task_id"], data["success"])
         elif kind == "recover":
             self.task_manager.recover_tasks(data["node_id"])
+            self.serve_queue.recover_node(data["node_id"])
             for rdzv in self.rdzv_managers.values():
                 rdzv.remove_alive_node(data["node_id"])
         elif kind == "kv_set":
@@ -273,6 +281,13 @@ class JobMaster:
         elif kind == "shard_ckpt":
             self.task_manager.restore_dataset_from_checkpoint(
                 data["content"])
+        elif kind == "serve_submit":
+            self.serve_queue.submit(data["requests"])
+        elif kind == "serve_lease":
+            self.serve_queue.lease_exact(data["node_id"],
+                                         data["request_ids"])
+        elif kind == "serve_result":
+            self.serve_queue.complete(data["results"])
         else:
             logger.warning("journal replay: unknown frame kind %r", kind)
         if idem:
@@ -291,6 +306,7 @@ class JobMaster:
             "paral": self._paral_config,
             "idem": self.idem_cache.export_state(),
             "policy": list(self._policy_decisions),
+            "serve": self.serve_queue.export_state(),
         }
 
     def snapshot_journal(self):
@@ -381,6 +397,27 @@ class JobMaster:
             states=states, wall_s=wall, other_s=other,
             goodput_fraction=(productive / total) if total > 0 else 0.0,
             nodes=len(self._goodput))
+
+    # ------------------------------------------------------------- serving
+
+    def collect_serve_stats(self, report: msg.ServeStatsReport):
+        """Latest-SENT-wins per-worker serving snapshot (BUFFERED verb,
+        same drain-ordering hazard as collect_goodput)."""
+        self.serve_queue.collect_stats(report)
+        for state, secs in report.states.items():
+            self.metric_collector.reg.gauge(
+                "dwt_serve_seconds", float(secs),
+                {"job": self.metric_collector.job, "state": str(state),
+                 "node": str(report.node_id)},
+                help="cumulative decode-worker wall seconds per state")
+        self.metric_collector.reg.gauge(
+            "dwt_serve_p99_ms", report.p99_ms,
+            {"job": self.metric_collector.job,
+             "node": str(report.node_id)},
+            help="per-worker p99 request latency")
+
+    def serve_summary(self) -> msg.ServeSummary:
+        return self.serve_queue.summary()
 
     # ------------------------------------------------------ adaptive policy
 
@@ -485,6 +522,7 @@ class JobMaster:
                 self.job_manager.process_event(
                     NodeEvent(NodeEventType.MODIFIED, dead))
                 self.task_manager.recover_tasks(node.id)
+                self.serve_queue.recover_node(node.id)
                 for rdzv in self.rdzv_managers.values():
                     rdzv.remove_alive_node(node.id)
                 self.speed_monitor.remove_running_worker(node.id)

@@ -158,6 +158,30 @@ class MasterServicer:
         if isinstance(payload, msg.GoodputQuery):
             return m.goodput_summary()
 
+        if isinstance(payload, msg.ServeLeaseRequest):
+            leased = m.serve_queue.lease(payload.node_id,
+                                         payload.max_requests)
+            resp = msg.ServeLease(requests=leased)
+            if not leased:
+                return resp
+            # a lease moves queue state: like TaskRequest dispatch, a
+            # retried lease crossing a master restart must get the SAME
+            # requests back or the originals strand in `leased` forever
+            self._journal("serve_lease", {
+                "node_id": payload.node_id,
+                "request_ids": [r.request_id for r in leased]},
+                idem=idem, resp=resp)
+            return resp
+
+        if isinstance(payload, msg.ServeResultQuery):
+            results, pending = m.serve_queue.take_results(
+                payload.request_ids)
+            return msg.ServeResultResponse(results=results,
+                                           pending=pending)
+
+        if isinstance(payload, msg.ServeStatsQuery):
+            return m.serve_summary()
+
         if isinstance(payload, msg.PolicyStateRequest):
             return m.policy_current()
 
@@ -269,6 +293,7 @@ class MasterServicer:
             m.job_manager.process_event(NodeEvent(NodeEventType.MODIFIED,
                                                   node))
             m.task_manager.recover_tasks(payload.node_id)
+            m.serve_queue.recover_node(payload.node_id)
             for rdzv in m.rdzv_managers.values():
                 rdzv.remove_alive_node(payload.node_id)
             m.note_policy_failure(payload.node_id)
@@ -340,6 +365,37 @@ class MasterServicer:
             self._journal("policy", {"decision": decision},
                           idem=idem, resp=resp)
             return resp
+
+        if isinstance(payload, msg.ServeSubmitRequest):
+            accepted = m.serve_queue.submit(payload.requests)
+            resp = msg.ServeSubmitAck(
+                accepted=accepted,
+                queue_depth=m.serve_queue.summary().queue_depth)
+            # a submitted request must survive this master: the ack is
+            # the client's permission to stop retrying, so the frame is
+            # durable first, and a retry crossing a restart replays the
+            # ack instead of double-enqueueing
+            self._journal("serve_submit",
+                          {"requests": list(payload.requests)},
+                          idem=idem, resp=resp)
+            return resp
+
+        if isinstance(payload, msg.ServeResultReport):
+            m.serve_queue.complete(payload.results)
+            resp = msg.OkResponse()
+            # results release leases and are what drain waits on — the
+            # same durability bar as task_result
+            self._journal("serve_result",
+                          {"results": list(payload.results),
+                           "node_id": node_id},
+                          idem=idem, resp=resp)
+            return resp
+
+        if isinstance(payload, msg.ServeStatsReport):
+            # pure telemetry (cumulative snapshot, latest-wins) — no
+            # journal frame; a master restart just waits for the next one
+            m.collect_serve_stats(payload)
+            return msg.OkResponse()
 
         if isinstance(payload, msg.DiagnosisReport):
             return m.diagnosis_manager.collect_report(payload)

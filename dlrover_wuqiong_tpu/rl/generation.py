@@ -10,12 +10,24 @@ one fused step program for the whole sampling loop (no per-token dispatch).
 The cached forward reuses the SAME parameter tree as `models/gpt.GPT`
 (paths h_<i>/attn/..., wte, wpe, ln_f), so a policy trained with the
 standard model generates without conversion.
+
+This module is the ONE decode-step implementation in the repo: the
+serving engine (serving/engine.py) drives the same `forward_step` with a
+*vector* of per-slot positions (each batch row at its own sequence
+position, continuous batching), while `generate` drives it with a scalar
+position (all rows in lockstep, RLHF sampling).  The vector path writes
+the new (k, v) through a one-hot `jnp.where` mask instead of
+`dynamic_update_slice` — per-row dynamic starts are not expressible as
+one slice, and masking keeps the step a single fused program (CLAUDE.md
+cond-collective rule).  Every op is row-independent, which is what makes
+a request's tokens bit-identical whether it decodes alone or packed in a
+busy batch (tests/test_serving.py pins this).
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import flax.linen as nn
 import jax
@@ -35,7 +47,8 @@ def _dense(p, x, dtype):
 def _cached_block(cfg: GPTConfig, p: Dict, x, cache_k, cache_v, pos):
     """One decoder block for ONE new token position with a KV cache.
 
-    x: (B, 1, C); cache_k/v: (B, max_len, H, D); pos: scalar index.
+    x: (B, 1, C); cache_k/v: (B, max_len, H, D); pos: scalar index (all
+    rows at the same position) or (B,) int vector (per-row positions).
     Returns (y, new_k, new_v).
     """
     B = x.shape[0]
@@ -47,12 +60,22 @@ def _cached_block(cfg: GPTConfig, p: Dict, x, cache_k, cache_v, pos):
     q = q.reshape(B, 1, H, D)
     k = k.reshape(B, 1, H, D)
     v = v.reshape(B, 1, H, D)
-    cache_k = jax.lax.dynamic_update_slice(cache_k, k, (0, pos, 0, 0))
-    cache_v = jax.lax.dynamic_update_slice(cache_v, v, (0, pos, 0, 0))
-    # attend over positions <= pos
+    L = cache_k.shape[1]
+    if jnp.ndim(pos) == 0:
+        cache_k = jax.lax.dynamic_update_slice(cache_k, k, (0, pos, 0, 0))
+        cache_v = jax.lax.dynamic_update_slice(cache_v, v, (0, pos, 0, 0))
+        # attend over positions <= pos
+        mask = (jnp.arange(L) <= pos)[None, None, None, :]
+    else:
+        # per-row positions: write through a one-hot mask (a per-row
+        # dynamic_update_slice start is not one slice) and build a
+        # per-row causal mask — the whole step stays one fused program
+        hit = (jnp.arange(L)[None, :] == pos[:, None])       # (B, L)
+        cache_k = jnp.where(hit[:, :, None, None], k, cache_k)
+        cache_v = jnp.where(hit[:, :, None, None], v, cache_v)
+        mask = (jnp.arange(L)[None, :] <= pos[:, None])[:, None, None, :]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, cache_k) / jnp.sqrt(
         jnp.float32(D)).astype(dtype)
-    mask = (jnp.arange(cache_k.shape[1]) <= pos)[None, None, None, :]
     scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
     att = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(dtype)
     y = jnp.einsum("bhqk,bkhd->bqhd", att, cache_v).reshape(B, 1, H * D)
@@ -65,11 +88,20 @@ def _cached_block(cfg: GPTConfig, p: Dict, x, cache_k, cache_v, pos):
     return x + h, cache_k, cache_v
 
 
-def _forward_one(cfg: GPTConfig, params: Dict, token, caches, pos):
-    """token (B, 1) int → logits (B, vocab); updates all layer caches."""
+def forward_step(cfg: GPTConfig, params: Dict, token, caches, pos
+                 ) -> Tuple[jax.Array, List[Tuple[jax.Array, jax.Array]]]:
+    """token (B, 1) int → logits (B, vocab); updates all layer caches.
+
+    ``pos`` is a scalar (lockstep decode, `generate`) or a (B,) vector
+    (per-slot positions, serving/engine.py).  The token's (k, v) is
+    written at ``pos`` and attention covers positions <= ``pos`` per row.
+    """
     dtype = cfg.dtype
     tok = params["wte"]["embedding"][token].astype(dtype)    # (B, 1, C)
-    pe = params["wpe"]["embedding"][pos][None, None].astype(dtype)
+    if jnp.ndim(pos) == 0:
+        pe = params["wpe"]["embedding"][pos][None, None].astype(dtype)
+    else:
+        pe = params["wpe"]["embedding"][pos][:, None].astype(dtype)
     x = tok + pe
     new_caches = []
     for i in range(cfg.n_layer):
@@ -82,11 +114,41 @@ def _forward_one(cfg: GPTConfig, params: Dict, token, caches, pos):
     return logits[:, 0], new_caches
 
 
-def _init_caches(cfg: GPTConfig, batch: int, max_len: int):
-    return [(jnp.zeros((batch, max_len, cfg.n_head, cfg.head_dim),
-                       cfg.dtype),
-             jnp.zeros((batch, max_len, cfg.n_head, cfg.head_dim),
-                       cfg.dtype)) for _ in range(cfg.n_layer)]
+# backwards-compatible private alias (pre-serving name)
+_forward_one = forward_step
+
+
+def init_caches(cfg: GPTConfig, batch: int, max_len: int,
+                dtype: Optional[Any] = None):
+    """Zeroed per-layer (k, v) buffers: list of (B, max_len, H, D) pairs."""
+    dtype = dtype if dtype is not None else cfg.dtype
+    return [(jnp.zeros((batch, max_len, cfg.n_head, cfg.head_dim), dtype),
+             jnp.zeros((batch, max_len, cfg.n_head, cfg.head_dim), dtype))
+            for _ in range(cfg.n_layer)]
+
+
+_init_caches = init_caches
+
+
+def sample_token(logits, key, temperature: float = 1.0, top_k: int = 0):
+    """One sampled token per row + its log-probability.
+
+    temperature <= 0 means greedy argmax (deterministic, key unused).
+    Shared by `generate` and the serving engine so "decoded alone" and
+    "decoded in a busy batch" draw from the same program.
+    """
+    logits = logits.astype(jnp.float32)
+    if temperature > 0:
+        logits = logits / max(temperature, 1e-6)
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if temperature > 0:
+        tok = jax.random.categorical(key, logits)
+    else:
+        tok = jnp.argmax(logits, axis=-1)
+    logp = jax.nn.log_softmax(logits, -1)
+    return tok, jnp.take_along_axis(logp, tok[:, None], 1)[:, 0]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -102,7 +164,9 @@ def generate(cfg: GPTConfig, params: Dict, prompt: jax.Array,
              ) -> Tuple[jax.Array, jax.Array]:
     """Sample continuations. prompt (B, P) int32 → (tokens (B, P+N),
     logprobs (B, N)) — logprobs are the policy's per-sampled-token log
-    probabilities (what PPO needs).
+    probabilities (what PPO needs).  Deterministic per key: the same
+    (params, prompt, rng, sample) yields the same tokens on every call
+    (tests/test_serving.py pins this).
     """
     B, P = prompt.shape
     N = sample.max_new_tokens
@@ -110,34 +174,28 @@ def generate(cfg: GPTConfig, params: Dict, prompt: jax.Array,
     if total > cfg.block_size:
         raise ValueError(f"prompt+new ({total}) exceeds block size "
                          f"{cfg.block_size}")
-    caches = _init_caches(cfg, B, total)
+    caches = init_caches(cfg, B, total)
 
     def prefill(carry, i):
         caches, _ = carry
-        logits, caches = _forward_one(cfg, params, prompt[:, i][:, None],
+        logits, caches = forward_step(cfg, params, prompt[:, i][:, None],
                                       caches, i)
-        return (caches, logits), None
+        # f32 regardless of cfg.dtype: the carry init is f32 and scan
+        # requires dtype-stable carries (bf16 configs hit this)
+        return (caches, logits.astype(jnp.float32)), None
 
     (caches, logits), _ = jax.lax.scan(
         prefill, (caches, jnp.zeros((B, cfg.vocab_size), jnp.float32)),
         jnp.arange(P))
 
-    def _sample_token(logits, key):
-        logits = logits.astype(jnp.float32) / max(sample.temperature, 1e-6)
-        if sample.top_k > 0:
-            kth = jax.lax.top_k(logits, sample.top_k)[0][:, -1:]
-            logits = jnp.where(logits < kth, -jnp.inf, logits)
-        tok = jax.random.categorical(key, logits)
-        logp = jax.nn.log_softmax(logits, -1)
-        return tok, jnp.take_along_axis(logp, tok[:, None], 1)[:, 0]
-
     def decode(carry, i):
         caches, logits, key = carry
         key, sub = jax.random.split(key)
-        tok, logp = _sample_token(logits, sub)
-        next_logits, caches = _forward_one(cfg, params, tok[:, None],
+        tok, logp = sample_token(logits, sub, sample.temperature,
+                                 sample.top_k)
+        next_logits, caches = forward_step(cfg, params, tok[:, None],
                                            caches, P + i)
-        return (caches, next_logits, key), (tok, logp)
+        return (caches, next_logits.astype(jnp.float32), key), (tok, logp)
 
     (_, _, _), (toks, logps) = jax.lax.scan(
         decode, (caches, logits, rng), jnp.arange(N))

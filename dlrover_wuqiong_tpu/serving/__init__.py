@@ -1,0 +1,34 @@
+"""Continuous-batching inference on the decode mesh.
+
+Parity: the reference delegates serving to vLLM
+(`atorch/atorch/rl/model_engine/model_engine.py:35` — generation routes
+to an external engine); DLRover itself has no serving plane.  Here
+serving is a first-class subsystem of the elastic framework: the same
+master that dispatches training shards dispatches inference requests
+(journaled + idempotent verbs), the same telemetry pillars attribute
+serving time (telemetry/serving.py) and trace each request, and the
+same chaos harness kills decode workers mid-traffic (`chaos
+serve-drain`) asserting zero dropped in-flight requests.
+
+TPU redesign — continuous (in-flight) batching with STATIC shapes:
+
+- Slot-based KV cache: fixed ``(max_slots, max_len)`` ring of per-layer
+  (k, v) buffers.  A finished request frees its slot; a new request is
+  admitted at a scan-window boundary by prefilling a one-row mini cache
+  and `dynamic_update_slice`-ing it into the big buffers.  The decode
+  step stays ONE fused jit program — no per-token or per-admission
+  recompiles (the compile-cache key covers slot count / max_len / quant
+  mode, serving/engine.py).
+- Inactive slots are frozen with ``jnp.where`` masks, never `lax.cond`
+  (the CLAUDE.md cond-collective rule), and stale cache positions are
+  harmless by write-then-attend: position p is (over)written by the
+  same forward that first attends it.
+- Sampling is keyed by ``fold_in(request_key, absolute_position)``, so
+  a request's tokens are bit-identical whether it decodes alone or
+  packed in a busy batch with slot churn (tests/test_serving.py).
+- Decode weights can be int8/fp8-quantized (ops/quantization.py) with a
+  one-hop ``sync_from_trainer`` handoff from a live trainer.
+"""
+
+from .engine import ServeSpec, ServingEngine, serve_step_cache_key  # noqa: F401
+from .scheduler import LocalServer, SlotScheduler  # noqa: F401

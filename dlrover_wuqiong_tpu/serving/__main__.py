@@ -1,0 +1,81 @@
+"""Standalone decode-worker entrypoint.
+
+Parity: reference `dlrover/python/elastic_agent/torch/training.py`'s
+node entrypoint (agent process joining a master by address) — here the
+node is a SERVING worker joining the same control plane.
+
+    python -m dlrover_wuqiong_tpu.serving --master HOST:PORT --node-id N \
+        [--slots 4] [--max-len 64] [--max-prompt-len 16] \
+        [--fused-tokens 4] [--quant int8] [--seconds 30] \
+        [--ckpt-dir DIR] [--model-seed 0]
+
+Builds a GPTConfig.nano() model with seed-deterministic params (every
+worker generation materializes the SAME weights, so a request re-admitted
+after a worker kill continues bit-identically — the serve-drain drill
+depends on this), then runs the ServingWorker loop against the master's
+Serve* verbs.  CPU-only self-provisioning mirrors __graft_entry__.py:
+the env var must be set BEFORE jax initializes in this process.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main(argv) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms",
+                      os.environ.get("JAX_PLATFORMS", "cpu"))
+
+    args = {"master": "", "node_id": 1, "slots": 4, "max_len": 64,
+            "max_prompt_len": 16, "fused_tokens": 4, "quant": "",
+            "seconds": 0.0, "ckpt_dir": "", "model_seed": 0,
+            "stats_every": 2}
+    it = iter(argv)
+    for a in it:
+        key = a.lstrip("-").replace("-", "_")
+        if key in args:
+            raw = next(it)
+            cur = args[key]
+            args[key] = type(cur)(raw) if not isinstance(cur, str) \
+                else raw
+        else:
+            print(f"unknown arg {a}", file=sys.stderr)
+            return 2
+    if not args["master"]:
+        print("--master HOST:PORT is required", file=sys.stderr)
+        return 2
+
+    from ..agent.master_client import MasterClient
+    from ..models.gpt import GPT, GPTConfig
+    from .engine import ServeSpec, ServingEngine
+    from .worker import ServingWorker
+
+    cfg = GPTConfig.nano()
+    params = GPT(cfg).init_params(jax.random.PRNGKey(args["model_seed"]))
+    spec = ServeSpec(max_slots=args["slots"], max_len=args["max_len"],
+                     max_prompt_len=args["max_prompt_len"],
+                     fused_tokens=args["fused_tokens"],
+                     quant=args["quant"])
+    engine = ServingEngine(cfg, params, spec)
+    client = MasterClient(args["master"], node_id=args["node_id"],
+                          node_type="serve-worker")
+    try:
+        client.register_node(node_rank=args["node_id"])
+    except Exception:  # noqa: BLE001 — registration is best-effort for
+        # standalone drills; leases work without it
+        pass
+    worker = ServingWorker(client, engine, ckpt_dir=args["ckpt_dir"],
+                           stats_every=args["stats_every"])
+    try:
+        worker.run(max_seconds=args["seconds"] or None)
+    finally:
+        client.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -27,6 +27,14 @@ from .ledger import (  # noqa: F401
     get_ledger,
     reset_ledger,
 )
+from .serving import (  # noqa: F401
+    SERVE_COUNTERS,
+    SERVE_SCHEMA_VERSION,
+    SERVE_STATES,
+    ServeLedger,
+    get_serve_ledger,
+    reset_serve_ledger,
+)
 from .recorder import (  # noqa: F401
     FLIGHT_SCHEMA_VERSION,
     FlightRecorder,

@@ -172,6 +172,67 @@ class TestPolicyVerbs:
         assert [f.checker for f in found] == ["idem-key-required"]
 
 
+class TestServeVerbs:
+    """The serving verb family (ServeSubmitRequest / ServeLeaseRequest /
+    ServeResultReport) sits in JOURNALED_VERBS + IDEM_VERBS: a lease or
+    result that vanishes across a master restart would double-decode or
+    drop an in-flight inference request — the exact property `chaos
+    serve-drain` pins end to end."""
+
+    def test_serve_submit_ack_without_journal_flagged(self, tmp_path):
+        found = _scan(tmp_path, "servicer.py", _SERVICER_PREAMBLE + """\
+        def _report(self, node_id, payload, idem=None):
+            if isinstance(payload, msg.ServeSubmitRequest):
+                accepted = self.m.serve_queue.submit(payload.requests)
+                return msg.ServeSubmitAck(accepted=accepted)
+            return None
+""")
+        assert [f.checker for f in found] == ["journal-before-ack"]
+        assert "ServeSubmitRequest" in found[0].message
+
+    def test_serve_result_journal_without_idem_flagged(self, tmp_path):
+        found = _scan(tmp_path, "servicer.py", _SERVICER_PREAMBLE + """\
+        def _report(self, node_id, payload, idem=None):
+            if isinstance(payload, msg.ServeResultReport):
+                self.m.serve_queue.complete(payload.results)
+                resp = msg.OkResponse()
+                self._journal("serve_result", {"node_id": node_id})
+                return resp
+            return None
+""")
+        assert [f.checker for f in found] == ["idem-key-required"]
+        assert "ServeResultReport" in found[0].message
+
+    def test_serve_lease_journal_before_ack_with_idem_clean(self, tmp_path):
+        # the in-tree servicer shape: the leased request ids are the
+        # journal payload (replay re-assigns the EXACT set), idem + resp
+        # ride the same frame
+        found = _scan(tmp_path, "servicer.py", _SERVICER_PREAMBLE + """\
+        def _get(self, node_id, payload, idem=None):
+            if isinstance(payload, msg.ServeLeaseRequest):
+                leased = self.m.serve_queue.lease(
+                    payload.node_id, payload.max_requests)
+                resp = msg.ServeLease(requests=leased)
+                self._journal("serve_lease",
+                              {"node_id": payload.node_id,
+                               "request_ids": [r.request_id
+                                               for r in leased]},
+                              idem=idem, resp=resp)
+                return resp
+            return None
+""")
+        assert found == []
+
+    def test_serve_client_send_without_idem_flagged(self, tmp_path):
+        found = _scan(tmp_path, "client.py", """\
+            class Client:
+                def submit_serve_requests(self, requests):
+                    req = msg.ServeSubmitRequest(requests=requests)
+                    return self._call_critical("report", req)
+        """)
+        assert [f.checker for f in found] == ["idem-key-required"]
+
+
 # ------------------------------------------------- idem-key-required
 
 

@@ -1,0 +1,527 @@
+"""Serving subsystem: continuous batching, schemas, queue, journal replay.
+
+Mirrors reference behavior only at the boundary (`atorch/atorch/rl/
+model_engine/model_engine.py:35` delegates generation to vLLM — the
+reference has no serving plane of its own to test), so everything here
+pins the TPU redesign's OWN invariants:
+
+- the continuous-batching EQUIVALENCE invariant: a request's tokens are
+  a pure function of (weights, prompt, seed) — identical whether it
+  decodes alone, packed in a busy batch, staggered mid-flight, or on an
+  engine with a different slot/fusion geometry (serving/engine.py's
+  write-then-attend + positional fold_in design);
+- seeded-sampling determinism, for both the serving engine and the RLHF
+  `generate()` that shares `forward_step` (rl/generation.py);
+- ADD-ONLY schema pins for the serving telemetry (SERVE_STATES /
+  SERVE_COUNTERS / snapshot keys, telemetry/serving.py) and the Serve*
+  control-plane message family (common/messages.py), in the
+  tests/test_policy.py pin style;
+- ServeQueueManager semantics (dedupe, FIFO, front-requeue on recovery,
+  idempotent complete, master-side requeue attribution) and their
+  survival across a master restart via journal replay
+  (master/serve_queue.py + master/master.py serve_* journal kinds).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+
+from dlrover_wuqiong_tpu.common import messages as msg
+from dlrover_wuqiong_tpu.master.serve_queue import ServeQueueManager
+from dlrover_wuqiong_tpu.models.gpt import GPT, GPTConfig
+from dlrover_wuqiong_tpu.serving import (
+    LocalServer,
+    ServeSpec,
+    ServingEngine,
+    serve_step_cache_key,
+)
+from dlrover_wuqiong_tpu.serving.scheduler import request_trace_id
+from dlrover_wuqiong_tpu.telemetry.serving import (
+    SERVE_COUNTERS,
+    SERVE_SCHEMA_VERSION,
+    SERVE_STATES,
+    ServeLedger,
+)
+
+# ------------------------------------------------------------- fixtures
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return GPTConfig.nano()
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return GPT(cfg).init_params(jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def engine(cfg, params):
+    """The shared 2-slot engine — small enough that 4 requests churn
+    slots, wide enough (max_len 48) for every request below."""
+    return ServingEngine(cfg, params, ServeSpec(
+        max_slots=2, max_len=48, max_prompt_len=8, fused_tokens=4))
+
+
+# (request_id, prompt, max_new_tokens, temperature, seed) — mixed
+# temperatures INCLUDING greedy (temp=0), mixed lengths, distinct seeds
+REQS = [
+    ("a", [1, 7, 13], 12, 1.0, 5),
+    ("b", [2, 9], 9, 0.0, 0),
+    ("c", [3, 4, 5, 6], 11, 1.0, 6),
+    ("d", [8], 12, 0.7, 7),
+]
+
+
+def _submit(server, spec):
+    rid, prompt, n, temp, seed = spec
+    server.submit(rid, prompt, max_new_tokens=n, seed=seed,
+                  temperature=temp)
+
+
+def _drain_scheduler(sch):
+    out = {}
+    while not sch.idle():
+        sch.step()
+        for r in sch.take_results():
+            out[r.request_id] = list(r.tokens)
+    for r in sch.take_results():
+        out[r.request_id] = list(r.tokens)
+    return out
+
+
+def _alone(eng, spec):
+    """Decode one request on an otherwise-empty batch."""
+    s = LocalServer(eng)
+    _submit(s, spec)
+    return s.drain()[spec[0]]
+
+
+# ---------------------------------------------------- spec validation
+
+
+class TestServeSpecValidation:
+    def test_bad_quant_mode(self, cfg, params):
+        with pytest.raises(ValueError, match="quant mode"):
+            ServingEngine(cfg, params, ServeSpec(quant="int4"))
+
+    def test_max_len_exceeds_block_size(self, cfg, params):
+        with pytest.raises(ValueError, match="block_size"):
+            ServingEngine(cfg, params, ServeSpec(
+                max_len=cfg.block_size + 1))
+
+    def test_bad_max_prompt_len(self, cfg, params):
+        with pytest.raises(ValueError, match="max_prompt_len"):
+            ServingEngine(cfg, params, ServeSpec(
+                max_len=32, max_prompt_len=64))
+        with pytest.raises(ValueError, match="max_prompt_len"):
+            ServingEngine(cfg, params, ServeSpec(max_prompt_len=0))
+
+    def test_bad_slots_and_fusion(self, cfg, params):
+        with pytest.raises(ValueError, match="max_slots"):
+            ServingEngine(cfg, params, ServeSpec(max_slots=0))
+        with pytest.raises(ValueError, match="fused_tokens"):
+            ServingEngine(cfg, params, ServeSpec(fused_tokens=0))
+
+    def test_admit_prompt_too_long(self, engine):
+        with pytest.raises(ValueError, match="prompt length"):
+            engine.admit(0, list(range(1, 10)), seed=0)  # 9 > 8
+
+    def test_admit_budget_exceeds_max_len(self, engine):
+        with pytest.raises(ValueError, match="max_len"):
+            engine.admit(0, [1, 2, 3], seed=0, max_new_tokens=46)
+
+    def test_admit_occupied_slot(self, engine):
+        engine.admit(0, [1, 2], seed=0)
+        try:
+            with pytest.raises(ValueError, match="occupied"):
+                engine.admit(0, [3, 4], seed=1)
+        finally:
+            engine.retire(0)
+
+
+# ----------------------------------------- continuous-batching equivalence
+
+
+class TestContinuousBatchingEquivalence:
+    def test_busy_batch_matches_alone(self, engine):
+        """4 requests on 2 slots: slots churn (finishers free a slot
+        mid-drain, waiters admit into it) yet every request's tokens are
+        bit-identical to decoding it alone."""
+        busy = LocalServer(engine)
+        for spec in REQS:
+            _submit(busy, spec)
+        packed = busy.drain()
+        assert set(packed) == {r[0] for r in REQS}
+        for spec in REQS:
+            assert len(packed[spec[0]]) == spec[2]
+            assert packed[spec[0]] == _alone(engine, spec), spec[0]
+
+    def test_staggered_admission_matches_alone(self, engine):
+        """Requests submitted MID-FLIGHT (after other requests already
+        decoded a few windows) still match their alone decode — slot
+        admission at a window boundary does not perturb tenants and the
+        late request does not see the earlier tenants' cache state."""
+        s = LocalServer(engine)
+        _submit(s, REQS[0])
+        _submit(s, REQS[1])
+        s.scheduler.step()  # a window decodes before the late arrivals
+        _submit(s, REQS[2])
+        _submit(s, REQS[3])
+        out = _drain_scheduler(s.scheduler)
+        for spec in REQS:
+            assert out[spec[0]] == _alone(engine, spec), spec[0]
+
+    def test_cross_geometry_identical(self, cfg, params, engine):
+        """A DIFFERENT batch geometry (3 slots, K=2 vs 2 slots, K=4)
+        produces the same tokens: the equivalence invariant is about the
+        request, not the executable."""
+        other = ServingEngine(cfg, params, ServeSpec(
+            max_slots=3, max_len=48, max_prompt_len=8, fused_tokens=2))
+        assert other.cache_key != engine.cache_key  # distinct programs
+        a = LocalServer(engine)
+        b = LocalServer(other)
+        for spec in REQS:
+            _submit(a, spec)
+            _submit(b, spec)
+        assert a.drain() == b.drain()
+
+    def test_greedy_ignores_seed(self, engine):
+        """temp=0 rows take the argmax branch of the jnp.where select —
+        the seed must be dead."""
+        rid, prompt, n, _, _ = REQS[1]
+        t1 = _alone(engine, (rid, prompt, n, 0.0, 0))
+        t2 = _alone(engine, (rid, prompt, n, 0.0, 12345))
+        assert t1 == t2
+
+
+# ------------------------------------------------- seeded determinism
+
+
+class TestSeededDeterminism:
+    def test_same_seed_same_tokens(self, engine):
+        spec = ("det", [5, 6, 7], 10, 1.0, 42)
+        assert _alone(engine, spec) == _alone(engine, spec)
+
+    def test_different_seed_differs(self, engine):
+        a = _alone(engine, ("s0", [5, 6, 7], 12, 1.0, 0))
+        b = _alone(engine, ("s1", [5, 6, 7], 12, 1.0, 1))
+        assert a != b
+
+    def test_rl_generate_same_key_deterministic(self, cfg, params):
+        """Serving and RLHF share one decode-step implementation
+        (rl/generation.forward_step); generate() must be a pure function
+        of (params, prompt, rng, sample)."""
+        from dlrover_wuqiong_tpu.rl.generation import (
+            SampleConfig,
+            generate,
+        )
+        prompt = jax.numpy.asarray([[1, 7, 13]], dtype=jax.numpy.int32)
+        sample = SampleConfig(max_new_tokens=8, temperature=1.0)
+        key = jax.random.PRNGKey(9)
+        t1, lp1 = generate(cfg, params, prompt, key, sample)
+        t2, lp2 = generate(cfg, params, prompt, key, sample)
+        assert np.array_equal(np.asarray(t1), np.asarray(t2))
+        assert np.array_equal(np.asarray(lp1), np.asarray(lp2))
+        t3, _ = generate(cfg, params, prompt, jax.random.PRNGKey(10),
+                         sample)
+        assert not np.array_equal(np.asarray(t1), np.asarray(t3))
+
+
+# ------------------------------------------------------- quant modes
+
+
+class TestQuantizedDecode:
+    def test_int8_decodes_and_syncs(self, cfg, params):
+        eng = ServingEngine(cfg, params, ServeSpec(
+            max_slots=1, max_len=16, max_prompt_len=4, fused_tokens=2,
+            quant="int8"))
+        spec = ("q", [1, 2], 6, 1.0, 3)
+        first = _alone(eng, spec)
+        assert len(first) == 6
+        # one-hop weight refresh: same tree structure → same programs,
+        # deterministic under the new weights too
+        fresh = GPT(cfg).init_params(jax.random.PRNGKey(1))
+        eng.sync_from_trainer(fresh)
+        after = _alone(eng, spec)
+        assert len(after) == 6
+        assert _alone(eng, spec) == after  # still deterministic
+
+    def test_sync_rejects_different_tree(self, cfg, params):
+        eng = ServingEngine(cfg, params, ServeSpec(
+            max_slots=1, max_len=16, max_prompt_len=4, fused_tokens=2))
+        with pytest.raises(ValueError, match="tree structure"):
+            eng.sync_from_trainer({"bogus": jax.numpy.ones((2, 2))})
+
+    def test_cache_key_covers_spec_and_quant(self, cfg):
+        base = ServeSpec(max_slots=2, max_len=32, max_prompt_len=8,
+                         fused_tokens=4)
+        k = serve_step_cache_key(cfg, base)
+        assert k == serve_step_cache_key(cfg, base)  # stable digest
+        for changed in (
+            dataclasses.replace(base, quant="int8"),
+            dataclasses.replace(base, quant="fp8"),
+            dataclasses.replace(base, max_slots=3),
+            dataclasses.replace(base, max_len=64),
+            dataclasses.replace(base, fused_tokens=2),
+            dataclasses.replace(base, top_k=8),
+        ):
+            assert serve_step_cache_key(cfg, changed) != k, changed
+
+
+# ------------------------------------------------- ADD-ONLY schema pins
+
+
+class TestServingSchemasAddOnly:
+    def test_serve_states_pinned(self):
+        required = {"prefill", "decode", "admission", "weight_sync",
+                    "idle", "degraded"}
+        missing = required - set(SERVE_STATES)
+        assert not missing, f"SERVE_STATES is add-only; lost {missing}"
+
+    def test_serve_counters_pinned(self):
+        required = {"submitted", "admitted", "finished", "requeued",
+                    "tokens_out"}
+        missing = required - set(SERVE_COUNTERS)
+        assert not missing, f"SERVE_COUNTERS is add-only; lost {missing}"
+        assert SERVE_SCHEMA_VERSION >= 1
+
+    def test_snapshot_keys_pinned(self):
+        led = ServeLedger()
+        led.start()
+        led.note_admit("r")
+        led.note_first_token("r")
+        led.note_finish("r")
+        snap = led.snapshot()
+        required = {"schema", "wall_s", "states", "other_s", "counters",
+                    "active_requests", "latency", "started_wall"}
+        missing = required - set(snap)
+        assert not missing, f"snapshot keys are add-only; lost {missing}"
+        lat = {"samples", "p50_ms", "p99_ms", "ttft_p50_ms",
+               "ttft_p99_ms"}
+        assert not lat - set(snap["latency"])
+        assert snap["latency"]["samples"] == 1
+        assert snap["active_requests"] == 0
+
+    def test_unknown_names_rejected(self):
+        led = ServeLedger()
+        led.start()
+        with pytest.raises(ValueError, match="add-only"):
+            led.account("serving", 1.0)
+        with pytest.raises(ValueError, match="add-only"):
+            led.count("dropped")
+
+    def test_window_accounting_uses_injected_clock(self):
+        t = {"now": 100.0}
+        led = ServeLedger(clock=lambda: t["now"])
+        led.start()
+        with led.window("decode"):
+            t["now"] += 2.5
+        snap = led.snapshot()
+        assert snap["states"]["decode"] == pytest.approx(2.5)
+        assert snap["wall_s"] == pytest.approx(2.5)
+
+    @pytest.mark.parametrize("cls,required", [
+        (msg.ServeRequest, {"request_id", "prompt", "max_new_tokens",
+                            "temperature", "seed", "deadline_s",
+                            "submitted_at"}),
+        (msg.ServeResult, {"request_id", "tokens", "finish_reason",
+                           "latency_s", "ttft_s"}),
+        (msg.ServeStatsReport, {"node_id", "wall_s", "states",
+                                "counters", "active_slots", "p50_ms",
+                                "p99_ms", "ttft_p50_ms", "ttft_p99_ms",
+                                "sent_at"}),
+        (msg.ServeSummary, {"queue_depth", "leased", "done",
+                            "submitted_total", "requeued_total",
+                            "done_total", "workers", "active_slots",
+                            "counters", "states", "p50_ms", "p99_ms"}),
+    ])
+    def test_message_fields_pinned(self, cls, required):
+        names = {f.name for f in dataclasses.fields(cls)}
+        missing = required - names
+        assert not missing, \
+            f"{cls.__name__} is add-only; lost {missing}"
+
+    def test_request_trace_id_deterministic(self):
+        tid = request_trace_id("req-00")
+        assert tid == request_trace_id("req-00")
+        assert len(tid) == 16 and int(tid, 16) >= 0
+        assert tid != request_trace_id("req-01")
+
+
+# --------------------------------------------------- serve queue manager
+
+
+def _req(rid, prompt=(1, 2)):
+    return msg.ServeRequest(request_id=rid, prompt=list(prompt),
+                            max_new_tokens=4, seed=0)
+
+
+def _res(rid, tokens=(7, 8, 9, 10)):
+    return msg.ServeResult(request_id=rid, tokens=list(tokens),
+                           latency_s=0.5, ttft_s=0.1)
+
+
+class TestServeQueueManager:
+    def test_submit_dedupes_pending_and_done(self):
+        q = ServeQueueManager()
+        assert q.submit([_req("a"), _req("b"), _req("a")]) == 2
+        assert q.submit([_req("a")]) == 0  # still pending
+        q.lease(1, 2)
+        q.complete([_res("a")])
+        assert q.submit([_req("a")]) == 0  # already done
+        assert q.summary().submitted_total == 2
+
+    def test_lease_is_fifo(self):
+        q = ServeQueueManager()
+        q.submit([_req(f"r{i}") for i in range(4)])
+        assert [r.request_id for r in q.lease(1, 2)] == ["r0", "r1"]
+        assert [r.request_id for r in q.lease(2, 9)] == ["r2", "r3"]
+        assert q.lease(3, 1) == []
+
+    def test_recover_requeues_to_front_in_order(self):
+        q = ServeQueueManager()
+        q.submit([_req(f"r{i}") for i in range(4)])
+        q.lease(1, 2)  # r0, r1 leased
+        assert q.recover_node(1) == 2
+        # requeued requests OUTRANK never-leased ones, original order
+        assert [r.request_id for r in q.lease(2, 4)] == \
+            ["r0", "r1", "r2", "r3"]
+        assert q.recover_node(99) == 0  # unknown node is a no-op
+
+    def test_complete_is_idempotent(self):
+        q = ServeQueueManager()
+        q.submit([_req("a")])
+        q.lease(1, 1)
+        assert q.complete([_res("a")]) == 1
+        assert q.complete([_res("a")]) == 0  # the retry after a lost ack
+        summ = q.summary()
+        assert summ.done_total == 1 and summ.leased == 0
+
+    def test_lease_exact_replays_assignment(self):
+        q = ServeQueueManager()
+        q.submit([_req("a"), _req("b")])
+        q.lease_exact(7, ["b"])  # journal replay path
+        assert [r.request_id for r in q.lease(1, 5)] == ["a"]
+        assert q.summary().leased == 2
+        # "b" really is node 7's lease: its recovery requeues exactly it
+        assert q.recover_node(7) == 1
+        assert [r.request_id for r in q.lease(2, 5)] == ["b"]
+
+    def test_summary_attributes_requeues_master_side(self):
+        """Workers cannot see their own death: the master folds its
+        requeue count into the pinned `requeued` counter even when no
+        worker ever reported one."""
+        q = ServeQueueManager()
+        q.submit([_req("a"), _req("b")])
+        q.lease(1, 2)
+        q.recover_node(1)
+        summ = q.summary()
+        assert summ.requeued_total == 2
+        assert summ.counters["requeued"] == 2
+
+    def test_take_results_pops_and_counts_pending(self):
+        q = ServeQueueManager()
+        q.submit([_req("a"), _req("b")])
+        q.lease(1, 2)
+        q.complete([_res("a")])
+        results, pending = q.take_results(["a", "b"])
+        assert [r.request_id for r in results] == ["a"]
+        assert pending == 1
+        assert q.take_results(["a", "b"]) == ([], 1)  # popped
+
+    def test_collect_stats_latest_sent_wins(self):
+        q = ServeQueueManager()
+        q.collect_stats(msg.ServeStatsReport(
+            node_id=1, counters={"finished": 9}, sent_at=200.0))
+        q.collect_stats(msg.ServeStatsReport(  # stale BUFFERED drain
+            node_id=1, counters={"finished": 3}, sent_at=100.0))
+        summ = q.summary()
+        assert summ.counters["finished"] == 9
+        assert summ.workers == 1
+
+
+# ------------------------------------------------- journal replay
+
+
+class TestServeJournalReplay:
+    def test_queue_state_survives_master_crash(self, tmp_path):
+        from dlrover_wuqiong_tpu.agent.master_client import MasterClient
+        from dlrover_wuqiong_tpu.master.master import JobMaster
+
+        jd = str(tmp_path / "journal")
+        m1 = JobMaster(port=0, journal_dir=jd)
+        m1.prepare()
+        front = MasterClient(f"127.0.0.1:{m1.port}", node_id=90)
+        wrk = MasterClient(f"127.0.0.1:{m1.port}", node_id=7,
+                           node_type="serve-worker")
+        ack = front.submit_serve_requests(
+            [_req("a"), _req("b"), _req("c")])
+        assert ack.accepted == 3
+        leased = wrk.lease_serve_requests(max_requests=2)
+        assert [r.request_id for r in leased] == ["a", "b"]
+        wrk.report_serve_results([_res("a")])
+        # crash: no clean stop, no final snapshot — replay must rebuild
+        m1._server.stop()  # noqa: SLF001
+
+        m2 = JobMaster(port=0, journal_dir=jd)
+        m2.prepare()
+        try:
+            front2 = MasterClient(f"127.0.0.1:{m2.port}", node_id=90)
+            summ = front2.get_serve_summary()
+            assert summ.submitted_total == 3
+            assert summ.done_total == 1
+            assert summ.leased == 1      # "b" still assigned to node 7
+            assert summ.queue_depth == 1  # "c" still pending
+            # the done result survives the restart and is collectable
+            resp = front2.get_serve_results(["a"])
+            assert [r.request_id for r in resp.results] == ["a"]
+            assert resp.results[0].tokens == [7, 8, 9, 10]
+            # node 7 died with the old master: its failure report routes
+            # through recover_node, and "b" requeues AHEAD of "c"
+            wrk2 = MasterClient(f"127.0.0.1:{m2.port}", node_id=7,
+                                node_type="serve-worker")
+            wrk2.report_failure("drill: node lost", level="process")
+            summ2 = front2.get_serve_summary()
+            assert summ2.requeued_total >= 1
+            assert summ2.counters.get("requeued", 0) >= 1
+            relief = MasterClient(f"127.0.0.1:{m2.port}", node_id=8,
+                                  node_type="serve-worker")
+            got = relief.lease_serve_requests(max_requests=1)
+            assert [r.request_id for r in got] == ["b"]
+        finally:
+            m2.stop()
+
+    def test_submit_retry_across_restart_is_idempotent(self, tmp_path):
+        """A ServeSubmitRequest acked by master #1 and RETRIED with the
+        same idem key against replayed master #2 must not re-enqueue."""
+        from dlrover_wuqiong_tpu.agent.master_client import MasterClient
+        from dlrover_wuqiong_tpu.master.master import JobMaster
+
+        jd = str(tmp_path / "journal")
+        m1 = JobMaster(port=0, journal_dir=jd)
+        m1.prepare()
+        mc = MasterClient(f"127.0.0.1:{m1.port}", node_id=90)
+        idem = "node90:serve-submit:1"
+        payload = msg.ServeSubmitRequest(node_id=90,
+                                         requests=[_req("a")])
+        ack = mc._client.report(payload, idem=idem)  # noqa: SLF001
+        assert ack.accepted == 1
+        m1._server.stop()  # noqa: SLF001
+
+        m2 = JobMaster(port=0, journal_dir=jd)
+        m2.prepare()
+        try:
+            mc2 = MasterClient(f"127.0.0.1:{m2.port}", node_id=90)
+            replay = mc2._client.report(payload, idem=idem)  # noqa: SLF001
+            assert replay.accepted == 1  # the JOURNALED response, not a
+            # re-application (dedupe would have returned accepted=0)
+            summ = mc2.get_serve_summary()
+            assert summ.submitted_total == 1
+            assert summ.queue_depth == 1
+        finally:
+            m2.stop()

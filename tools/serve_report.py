@@ -1,0 +1,79 @@
+"""Serving report: ONE JSON line for the driver/operator.
+
+    python tools/serve_report.py [--addr HOST:PORT]   # live master RPC
+
+Pulls the master's job-level serving aggregation (master/serve_queue.py
+``summary()``): queue depth, leases, active slots, throughput (RPS and
+the pinned serving counters) and the latency tails workers push with
+their BUFFERED ServeStatsReport snapshots (latest-SENT-wins per node,
+tails aggregated as worst-worker — a conservative upper bound).  The
+address defaults to DWT_MASTER_ADDR.
+
+Exit/error contract matches tools/goodput_report.py and
+tools/policy_report.py: one JSON line ALWAYS — a missing address is
+rc=2 with an ``error`` field, any failure is rc=1 with an ``error``
+field, never a raw traceback on stdout.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _from_master(addr: str) -> dict:
+    from dlrover_wuqiong_tpu.agent.master_client import MasterClient
+
+    mc = MasterClient(addr, node_id=-1)
+    try:
+        s = mc.get_serve_summary()
+    finally:
+        mc.close()
+    return {
+        "source": "master", "addr": addr,
+        "workers": s.workers,
+        "queue_depth": s.queue_depth,
+        "leased": s.leased,
+        "active_slots": s.active_slots,
+        "submitted_total": s.submitted_total,
+        "done_total": s.done_total,
+        "requeued_total": s.requeued_total,
+        "rps": round(s.rps, 3),
+        "p50_ms": round(s.p50_ms, 2),
+        "p99_ms": round(s.p99_ms, 2),
+        "ttft_p50_ms": round(s.ttft_p50_ms, 2),
+        "ttft_p99_ms": round(s.ttft_p99_ms, 2),
+        "counters": {k: int(v) for k, v in sorted(s.counters.items())},
+        "states": {k: round(float(v), 3)
+                   for k, v in sorted(s.states.items())},
+    }
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    addr = None
+    it = iter(argv)
+    for a in it:
+        if a == "--addr":
+            addr = next(it, None)
+        elif a in ("-h", "--help"):
+            print(__doc__, file=sys.stderr)
+            return 0
+    try:
+        addr = addr or os.getenv("DWT_MASTER_ADDR", "")
+        if not addr:
+            print(json.dumps({"error": "no master address: pass --addr "
+                              "or set DWT_MASTER_ADDR"}))
+            return 2
+        report = _from_master(addr)
+    except Exception as e:  # noqa: BLE001 — the JSON contract beats purity
+        print(json.dumps({"error": repr(e)[:500]}))
+        return 1
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
