@@ -224,11 +224,24 @@ def _main():
         raise RuntimeError(f"all batch sizes OOM'd; last: {last_err_msg}")
 
     tokens_per_sec = steps * batch * seq / dt
+
+    # windowed device trace over the (already warm) headline step:
+    # StepProfiler + utils/xplane.py category split, opt-in because the
+    # trace dump costs seconds and disk (DWT_BENCH_TRACE_DIR=/path)
+    trace_report = {}
+    if os.getenv("DWT_BENCH_TRACE_DIR"):
+        try:
+            trace_report = _traced_window(
+                res, cfg, batch, seq, state,
+                os.environ["DWT_BENCH_TRACE_DIR"])
+        except Exception as e:  # noqa: BLE001
+            trace_report = {"trace_error": repr(e)[:300]}
     n_params = cfg.num_params() if hasattr(cfg, "num_params") else None
 
     # side metrics → stderr
     side = {"backend": backend, "seq": seq, "batch": batch,
             "step_ms": dt / steps * 1e3}
+    side.update(trace_report)
 
     # fused K-step dispatch vs the per-step driver (ISSUE 3 tentpole):
     # measured on every backend — on CPU the dispatch overhead IS the
@@ -397,6 +410,11 @@ def _main():
         line.update({k: serve_report[k] for k in
                      ("serve_tokens_per_s", "serve_p50_ms",
                       "serve_p99_ms", "serve_vs_sequential")})
+    if trace_report.get("device_op_categories"):
+        # add-only: the device-op category split of the headline step
+        # (DWT_BENCH_TRACE_DIR window) rides the same line so the
+        # artifact says WHERE the step time goes, not just how much
+        line["device_op_categories"] = trace_report["device_op_categories"]
     # goodput split for the bench process itself: compile vs productive
     # vs checkpoint states (credited by the engine) — side experiments
     # land in other_s by design
@@ -492,6 +510,49 @@ def _fused_vs_perstep(res, cfg, batch, seq, state):
         "perstep_driver_tokens_per_s": round(batch * seq / per_step_s, 1),
         "fused_tokens_per_s": round(batch * seq / fused_step_s, 1),
         "fused_vs_perstep": round(per_step_s / fused_step_s, 3),
+    }
+
+
+def _traced_window(res, cfg, batch, seq, state, trace_dir, steps=3):
+    """Device-op category split of the headline step (DWT_BENCH_TRACE_DIR).
+
+    Runs a short windowed jax.profiler trace (utils/profiler.py
+    StepProfiler, the same orchestration the trainer uses) over the
+    ALREADY-COMPILED headline step and aggregates the XPlane into
+    per-category device seconds (utils/xplane.py).  Over the axon tunnel
+    the xplane carries one opaque event per executable run (bench
+    docstring) — the category split is only informative where the
+    backend exports real op events (local CPU/TPU), so a parse that
+    yields nothing degrades to an explanatory key, never a failure."""
+    from dlrover_wuqiong_tpu.utils.profiler import StepProfiler
+
+    data = jax.random.randint(jax.random.PRNGKey(3), (batch, seq + 1),
+                              0, cfg.vocab_size)
+    b = res.place_batch({"input_ids": data[:, :-1], "labels": data[:, 1:]})
+    st = jax.tree.map(jnp.copy, state)
+    prof = StepProfiler(trace_dir=trace_dir, start_step=0,
+                        end_step=steps - 1, job_name="bench")
+    try:
+        for i in range(steps):
+            with prof.step(i):
+                st, m = res.train_step(st, b)
+                if i == steps - 1:
+                    float(m["loss"])  # sync INSIDE the window: the trace
+                    # must contain the device work it claims to time
+    finally:
+        prof.close()
+    if prof.last_profile is None:
+        return {"trace_dir": trace_dir,
+                "trace_error": "xplane parse yielded no op events"}
+    p = prof.last_profile
+    return {
+        "trace_dir": trace_dir,
+        "trace_steps": steps,
+        "device_op_categories": {k: round(v, 6)
+                                 for k, v in sorted(p.categories.items())},
+        "trace_top_ops": [{"op": op.name, "category": op.category,
+                           "total_s": round(op.total_s, 6)}
+                          for op in p.top(k=5)],
     }
 
 

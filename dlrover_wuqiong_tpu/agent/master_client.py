@@ -399,6 +399,18 @@ class MasterClient:
             "get", msg.PolicyHistoryRequest(node_id=self.node_id))
         return json.loads(resp.content) if resp.content else []
 
+    # ---------------------------------------------------- incident timeline
+
+    def get_timeline(self, ckpt_dir: str = "") -> msg.TimelineResponse:
+        """Assembled incident timeline (tools/incident_report.py).
+
+        POLLING class: a post-mortem query must fail fast against a dead
+        master — the offline reconstruction from the same disk artifacts
+        is the fallback, and it is byte-equal by contract."""
+        return self._call_polling(
+            "get", msg.TimelineQuery(node_id=self.node_id,
+                                     ckpt_dir=ckpt_dir))
+
     # ------------------------------------------------------------- serving
 
     def submit_serve_requests(self, requests: List[msg.ServeRequest]

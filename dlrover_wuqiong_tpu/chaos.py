@@ -1733,6 +1733,12 @@ stats = ctx.mc.degraded_stats()
 with open(os.path.join(marker_dir, "done"), "w") as f:
     json.dump({"steps": step, "stats": stats,
                "ledger": led.snapshot()}, f)
+# flight dump carries this worker's ledger + events into the incident
+# timeline the drill gates on (telemetry/timeline.py): flush BEFORE
+# exit so the offline assembly sees the same artifacts the live
+# TimelineQuery does
+from dlrover_wuqiong_tpu.telemetry import get_recorder
+get_recorder().flush(_ckpt_dir, "drill-end")
 """
 
 
@@ -1911,6 +1917,58 @@ def master_kill(dataset_size: int = 576, batch: int = 4,
                                     and stats.get("dropped_total", 1) == 0)
         report["epoch_bumped"] = 2 in stats.get("epochs_seen", [])
         report["reregistered"] = stats.get("reregistrations", 0) >= 1
+
+        # ------------------------------------------- incident timeline gate
+        # The drill's observability claim (telemetry/timeline.py): the live
+        # TimelineQuery against the RESTARTED master byte-equals the offline
+        # assembly from the same disk artifacts, every journaled event
+        # appears exactly once in (epoch, seq) order across the fencing
+        # bump, and the narrative's degraded attribution agrees with the
+        # worker's own ledger.
+        from .agent.master_client import MasterClient
+        from .telemetry import timeline as tl
+
+        ckpt_dir = os.path.join(work, "ckpt")
+        mc = MasterClient(addr, node_id=-1)
+        try:
+            live = mc.get_timeline(ckpt_dir=ckpt_dir)
+        finally:
+            mc.close()
+        offline = tl.assemble_incident(journal_dir=journal_dir,
+                                       ckpt_dir=ckpt_dir)
+        report["timeline_events"] = live.events
+        report["timeline_byte_equal"] = (
+            live.content == tl.incident_json(offline))
+        jkeys = [(e["epoch"], e["seq"]) for e in offline["events"]
+                 if e["source"] == "journal" and e["kind"] != "flush"]
+        report["timeline_causal"] = (
+            jkeys == sorted(jkeys) and len(jkeys) == len(set(jkeys))
+            and len(jkeys) == offline["counts"]["journal_events"])
+        report["timeline_epochs"] = offline["counts"]["epochs"]
+        narr = offline["narrative"]
+        deg_lost = sum(float(i.get("lost_s", 0.0))
+                       for i in narr["incidents"]
+                       if i.get("attributed_state") == "degraded")
+        report["timeline_degraded_s"] = round(deg_lost, 3)
+        report["timeline_attribution_ok"] = abs(
+            deg_lost - report["ledger"]["degraded_s"]) <= 0.05
+        # the offline CLI on the same artifacts must hash to the live bytes
+        tools_dir = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        p = subprocess.run(
+            [sys.executable, os.path.join(tools_dir, "incident_report.py"),
+             "--journal", journal_dir, "--flight", ckpt_dir],
+            capture_output=True, text=True, env=env, timeout=120)
+        try:
+            cli_line = json.loads(p.stdout)
+        except ValueError:
+            cli_line = {}
+        report["incident_report_rc"] = p.returncode
+        report["incident_report_sha_match"] = bool(
+            p.returncode == 0
+            and cli_line.get("timeline_sha256")
+            == tl.incident_sha256(live.content))
+
         report["ok"] = bool(
             report["completed"] and cli.returncode == 0
             and report["worker_generations"] == 1
@@ -1921,7 +1979,12 @@ def master_kill(dataset_size: int = 576, batch: int = 4,
             and report["epoch_bumped"] and report["reregistered"]
             and report["ledger"]["degraded_s"] > 0
             and report["ledger"]["productive_s"] > 0
-            and report["goodput_wall"] >= target)
+            and report["goodput_wall"] >= target
+            and report["timeline_byte_equal"]
+            and report["timeline_causal"]
+            and report["timeline_epochs"] == [1, 2]
+            and report["timeline_attribution_ok"]
+            and report["incident_report_sha_match"])
         return report
     finally:
         if master.poll() is None:
@@ -2133,10 +2196,64 @@ def serve_drain(n_requests: int = 8, max_new_tokens: int = 24,
         # decides whether a killed request was already admitted)
         report["trace_trees_cross_generation"] = cross_generation
 
+        # ------------------------------------------- incident timeline gate
+        # w2 re-flushes its flight ring on every stats push — freeze the
+        # artifacts FIRST or live-vs-offline byte equality is a race
+        w2.kill()
+        w2.wait(timeout=10)
+        from .telemetry import timeline as tl
+
+        live = cli.get_timeline(ckpt_dir=ckpt_dir)
+        offline = tl.assemble_incident(journal_dir=journal_dir,
+                                       ckpt_dir=ckpt_dir)
+        report["timeline_events"] = live.events
+        report["timeline_byte_equal"] = (
+            live.content == tl.incident_json(offline))
+        jkeys = [(e["epoch"], e["seq"]) for e in offline["events"]
+                 if e["source"] == "journal"]
+        report["timeline_causal"] = (
+            jkeys == sorted(jkeys) and len(jkeys) == len(set(jkeys)))
+        # exactly-once on the timeline itself: the serve_result journal
+        # events' request ids tile the submitted set exactly once (the
+        # requeue produced a second LEASE, never a second result), and
+        # the batch submit journaled exactly one frame
+        result_ids: list = []
+        n_submit = 0
+        for e in offline["events"]:
+            if e["source"] != "journal":
+                continue
+            if e["kind"] == "serve_result":
+                result_ids += list(e["data"].get("request_ids", []))
+            elif e["kind"] == "serve_submit":
+                n_submit += 1
+        report["timeline_serve_exactly_once"] = (
+            sorted(result_ids) == sorted(r.request_id for r in reqs)
+            and n_submit == 1)
+        # the offline CLI on the same artifacts must hash to the live bytes
+        tools_dir = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        p = subprocess.run(
+            [sys.executable, os.path.join(tools_dir, "incident_report.py"),
+             "--journal", journal_dir, "--flight", ckpt_dir],
+            capture_output=True, text=True, env=env, timeout=120)
+        try:
+            cli_line = json.loads(p.stdout)
+        except ValueError:
+            cli_line = {}
+        report["incident_report_rc"] = p.returncode
+        report["incident_report_sha_match"] = bool(
+            p.returncode == 0
+            and cli_line.get("timeline_sha256")
+            == tl.incident_sha256(live.content))
+
         report["ok"] = bool(
             report["zero_dropped"] and report["bit_identical"]
             and report["requeued_total"] > 0
-            and report["requeued_counter"] > 0 and trees_ok)
+            and report["requeued_counter"] > 0 and trees_ok
+            and report["timeline_byte_equal"]
+            and report["timeline_causal"]
+            and report["timeline_serve_exactly_once"]
+            and report["incident_report_sha_match"])
         return report
     finally:
         tails = {}

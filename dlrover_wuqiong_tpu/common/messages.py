@@ -575,3 +575,29 @@ class ServeSummary:
     ttft_p50_ms: float = 0.0
     ttft_p99_ms: float = 0.0
     rps: float = 0.0
+
+
+# ------------------------------------------------------------ incident timeline
+
+
+@message
+class TimelineQuery:
+    """Client → master: assemble the incident timeline (POLLING class,
+    read-only — never journaled).  The master answers from its own disk
+    artifacts (journal dir + the optional ``ckpt_dir`` flight-dump root),
+    so the offline `tools/incident_report.py` reconstruction from the
+    same artifacts is byte-equal to ``TimelineResponse.content``.
+    ADD-ONLY family, pinned by tests/test_timeline.py."""
+
+    node_id: int = -1
+    ckpt_dir: str = ""
+
+
+@message
+class TimelineResponse:
+    """``content`` is the canonical incident JSON
+    (telemetry/timeline.py incident_json: events + narrative + counts);
+    ``events`` is the merged stream length for a cheap sanity check."""
+
+    content: str = ""
+    events: int = 0

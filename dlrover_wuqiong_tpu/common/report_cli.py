@@ -1,0 +1,75 @@
+"""Shared plumbing for the one-line-JSON report CLIs under tools/.
+
+Parity: no reference counterpart — the reference's operator surface is
+`kubectl logs` + dashboards; this repo's contract (BASELINE.md / driver)
+is ONE parseable JSON line per tool on stdout, ALWAYS.
+
+Factored from the previously copy-pasted mains of
+tools/goodput_report.py, tools/policy_report.py and
+tools/serve_report.py (tools/incident_report.py builds on it directly).
+The contract every tool shares:
+
+- ``-h``/``--help`` prints the module docstring to STDERR, rc=0 (stdout
+  stays machine-parseable);
+- offline source flags (e.g. ``--flight``, ``--journal``) win over the
+  live master RPC;
+- a live query with no address (``--addr`` / $DWT_MASTER_ADDR) is rc=2
+  with an ``error`` field;
+- any failure is rc=1 with an ``error`` field — never a raw traceback
+  on stdout;
+- success prints exactly one ``json.dumps(report)`` line, rc=0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Callable, Dict, Optional, Sequence
+
+
+def parse_value_flags(argv: Sequence[str], value_flags: Sequence[str]
+                      ) -> Dict[str, Optional[str]]:
+    """``--flag VALUE`` pairs (unknown args are ignored, matching the
+    historical tolerant manual loops); ``-h``/``--help`` maps to itself."""
+    vals: Dict[str, Optional[str]] = {}
+    it = iter(argv)
+    for a in it:
+        if a in ("-h", "--help"):
+            vals["--help"] = a
+        elif a in value_flags:
+            vals[a] = next(it, None)
+    return vals
+
+
+def run_report(argv: Optional[Sequence[str]], doc: str,
+               offline: Callable[[Dict[str, Optional[str]]],
+                                 Optional[dict]],
+               live: Callable[[str, Dict[str, Optional[str]]], dict],
+               no_addr_error: str,
+               value_flags: Sequence[str] = (),
+               addr_env: str = "DWT_MASTER_ADDR") -> int:
+    """One report CLI run under the shared rc/error contract.
+
+    ``offline(vals)`` returns the report when its flags were given, or
+    None to fall through to ``live(addr, vals)``.
+    """
+    argv = argv if argv is not None else sys.argv[1:]
+    flags = tuple(value_flags) + ("--addr",)
+    vals = parse_value_flags(argv, flags)
+    if "--help" in vals:
+        print(doc, file=sys.stderr)
+        return 0
+    try:
+        report = offline(vals)
+        if report is None:
+            addr = vals.get("--addr") or os.getenv(addr_env, "")
+            if not addr:
+                print(json.dumps({"error": no_addr_error}))
+                return 2
+            report = live(addr, vals)
+    except Exception as e:  # noqa: BLE001 — the JSON contract beats purity
+        print(json.dumps({"error": repr(e)[:500]}))
+        return 1
+    print(json.dumps(report))
+    return 0

@@ -15,8 +15,15 @@ the kv store, the node registry and the paral config, then bumps a
 new incarnation (common/comm.py).
 
 Format: one frame per line (`serialize.dumps` emits compact JSON with no
-raw newlines).  Every frame carries a monotonically increasing ``seq``;
-the snapshot records the seq it covers, so replay after a crash BETWEEN
+raw newlines).  Every frame carries a monotonically increasing ``seq``
+and an ADD-ONLY wall-clock ``ts`` stamped at append time — a persisted
+cross-process timestamp (never duration math) that lets
+telemetry/timeline.py interleave journal frames with worker flight
+events on one wall timeline; causal order WITHIN the journal stays
+(fencing epoch, seq), so a stepped wall clock cannot reorder frames.
+Replay tolerates frames without ``ts`` (journals written before it
+existed).  The snapshot records the seq it covers, so replay after a
+crash BETWEEN
 "snapshot written" and "journal truncated" skips the already-snapshotted
 prefix instead of double-applying (kv_store_add replayed twice would
 drift the counter).  A torn final line — the master was SIGKILLed
@@ -34,6 +41,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -132,8 +140,11 @@ class MasterJournal:
         an acked RPC implies a durable record."""
         with self._lock:
             self._seq += 1
+            # ts is a PERSISTED cross-process timestamp for the incident
+            # timeline, never duration math — causal order stays
+            # (epoch, seq)  # graftlint: disable=wall-clock-duration -- persisted cross-process timestamp (timeline interleaving), not elapsed-time math
             frame = serialize.dumps({"seq": self._seq, "kind": kind,
-                                     "data": data})
+                                     "ts": time.time(), "data": data})
             try:
                 if self._fh is None:
                     self._fh = open(self._path, "ab")
@@ -160,7 +171,7 @@ class MasterJournal:
         """
         with self._lock:
             frame = serialize.dumps({"epoch": self.epoch, "seq": self._seq,
-                                     "state": state})
+                                     "ts": time.time(), "state": state})
             tmp = self._snap_path + ".tmp"
             try:
                 with open(tmp, "wb") as f:
@@ -177,6 +188,7 @@ class MasterJournal:
                     self._seq += 1
                     f.write(serialize.dumps(
                         {"seq": self._seq, "kind": "epoch",
+                         "ts": time.time(),
                          "data": {"epoch": self.epoch}}) + b"\n")
                     f.flush()
                     os.fsync(f.fileno())  # graftlint: disable=blocking-under-lock -- same compaction critical section: the fresh journal must be durable before the swap

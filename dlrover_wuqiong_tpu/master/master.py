@@ -452,6 +452,22 @@ class JobMaster:
         return json.dumps([dataclasses.asdict(d)
                            for d in self._policy_decisions])
 
+    def timeline_report(self, ckpt_dir: str = "") -> msg.TimelineResponse:
+        """Assembled incident timeline (telemetry/timeline.py) over this
+        master's journal dir + the caller's flight-dump root.
+
+        Deliberately a pure function of the DISK artifacts, not the
+        in-memory managers: `tools/incident_report.py --journal/--flight`
+        on the same paths must reconstruct byte-equal canonical JSON
+        (chaos master-kill / serve-drain gate on exactly that)."""
+        from ..telemetry import timeline as tl
+
+        journal_dir = self.journal.dir if self.journal is not None else ""
+        report = tl.assemble_incident(journal_dir=journal_dir,
+                                      ckpt_dir=ckpt_dir)
+        return msg.TimelineResponse(content=tl.incident_json(report),
+                                    events=len(report["events"]))
+
     def note_policy_failure(self, node_id: int):
         """Feed the rate estimator from the NodeFailure/dead-node paths
         (the same events the journal records as "recover" frames)."""

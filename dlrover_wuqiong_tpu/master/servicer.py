@@ -188,6 +188,12 @@ class MasterServicer:
         if isinstance(payload, msg.PolicyHistoryRequest):
             return msg.PolicyHistory(content=m.policy_history_json())
 
+        if isinstance(payload, msg.TimelineQuery):
+            # read-only incident assembly from disk artifacts (never
+            # journaled): the answer must stay byte-equal to the offline
+            # reconstruction, so no in-memory state contributes
+            return m.timeline_report(payload.ckpt_dir)
+
         raise ValueError(f"unknown get message: {type(payload).__name__}")
 
     def _report(self, node_id: int, node_type: str, payload: Any,

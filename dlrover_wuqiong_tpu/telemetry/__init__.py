@@ -15,9 +15,14 @@ and each process keeps a bounded flight-recorder ring flushed to
 substrate the Brain's adaptive policies read from instead of chaos-drill
 ad-hoc timers.
 
-Schemas are ADD-ONLY: ``LEDGER_STATES``, the ledger snapshot keys and the
-flight-dump envelope keys are pinned by tests/test_telemetry.py — extend,
-never rename.
+The incident timeline (telemetry/timeline.py) merges all of the above
+plus the master journal into ONE causally-ordered event stream — live
+via the TimelineQuery verb, offline via tools/incident_report.py,
+byte-equal either way.
+
+Schemas are ADD-ONLY: ``LEDGER_STATES``, the ledger snapshot keys, the
+flight-dump envelope keys (tests/test_telemetry.py) and the timeline
+event envelope (tests/test_timeline.py) — extend, never rename.
 """
 
 from .ledger import (  # noqa: F401
@@ -42,6 +47,16 @@ from .recorder import (  # noqa: F401
     get_recorder,
     load_flight_dumps,
     reset_recorder,
+)
+from .timeline import (  # noqa: F401
+    TIMELINE_EVENT_KEYS,
+    TIMELINE_SCHEMA_VERSION,
+    assemble_incident,
+    build_narrative,
+    export_perfetto,
+    incident_json,
+    incident_sha256,
+    trace_tree,
 )
 from .spans import (  # noqa: F401
     SPAN_SCHEMA_VERSION,

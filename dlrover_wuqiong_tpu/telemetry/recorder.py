@@ -11,13 +11,21 @@ to ``$ckpt_dir/flight/`` where they survive the process.
 Dump layout (ADD-ONLY schema, pinned by tests/test_telemetry.py):
 
     $ckpt_dir/flight/<role>-<pid>-<reason>-<seq>.json
-    {"schema": 1, "role", "pid", "reason", "flushed_at",
-     "ledger": <ledger snapshot or null>, "events": [...]}
+    {"schema": 1, "role", "pid", "reason", "flushed_at", "flushed_mono",
+     "ledger": <ledger snapshot or null>,
+     "serve_ledger": <serve-ledger snapshot or null>, "events": [...]}
 
-Events are ``{"t_wall", "kind", "name", "data"}``; ``kind`` is one of
-span | node_event | state | mark.  Spans recorded here carry their full
-trace fields, so one restore reconstructs as a single trace tree across
-agent/master/saver dumps (tools/goodput_report.py --flight).
+Events are ``{"t_wall", "t_mono", "kind", "name", "data"}``; ``kind`` is
+one of span | node_event | state | mark.  Spans recorded here carry
+their full trace fields, so one restore reconstructs as a single trace
+tree across agent/master/saver dumps (tools/goodput_report.py --flight).
+
+Clocks: each event carries BOTH the wall clock (cross-process alignment)
+and the monotonic clock; the envelope's ``flushed_at``/``flushed_mono``
+pair anchors the process's monotonic timeline to the wall at flush time,
+so telemetry/timeline.py can order a process's own events immune to wall
+steps (``wall = t_mono + (flushed_at - flushed_mono)``).  Dumps written
+before the monotonic fields existed fall back to ``t_wall`` there.
 
 Writes are write-tmp-then-rename (atomic publish); flushing is
 best-effort and must never take down the faulting process's last words.
@@ -52,8 +60,10 @@ class FlightRecorder:
         self._seq = 0
 
     def record(self, kind: str, name: str, data: Optional[Dict] = None):
-        evt = {"t_wall": time.time(), "kind": kind, "name": name,
-               "data": data or {}}
+        # t_wall is a persisted cross-process timestamp (sanctioned wall
+        # use); t_mono is the anchor-safe sibling timeline.py orders by
+        evt = {"t_wall": time.time(), "t_mono": time.monotonic(),
+               "kind": kind, "name": name, "data": data or {}}
         with self._lock:
             self._ring.append(evt)
 
@@ -73,6 +83,7 @@ class FlightRecorder:
             return None
         try:
             from .ledger import get_ledger
+            from .serving import get_serve_ledger
             from .spans import process_role
 
             out_dir = flight_dir(ckpt_dir)
@@ -88,9 +99,16 @@ class FlightRecorder:
                 "role": process_role(),
                 "pid": os.getpid(),
                 "reason": reason,
+                # the wall/monotonic PAIR is the anchor: both stamped
+                # back to back so their difference maps this process's
+                # t_mono values onto the shared wall timeline
                 "flushed_at": time.time(),
+                "flushed_mono": time.monotonic(),
                 "ledger": (get_ledger().snapshot()
                            if get_ledger().started() else None),
+                "serve_ledger": (get_serve_ledger().snapshot()
+                                 if get_serve_ledger().started()
+                                 else None),
                 "events": self.snapshot(),
             }
             tmp = f"{path}.tmp"

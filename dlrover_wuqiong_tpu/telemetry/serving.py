@@ -91,6 +91,13 @@ class ServeLedger:
                 self._t_start = self._clock()
                 self._started_wall = time.time()
 
+    def started(self) -> bool:
+        """True once `start()` opened the window (mirrors GoodputLedger —
+        the flight recorder embeds a snapshot only from a started
+        ledger, so an idle process dumps null, not an all-zero split)."""
+        with self._lock:
+            return self._t_start is not None
+
     # ------------------------------------------------------------ credits
 
     def account(self, state: str, seconds: float):

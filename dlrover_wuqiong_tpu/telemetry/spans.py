@@ -173,13 +173,38 @@ def clear_spans():
         _BUFFER.clear()
 
 
-def dump_chrome_trace(path: str, extra_spans: Optional[List[Dict]] = None):
+def dump_chrome_trace(path: str, extra_spans: Optional[List[Dict]] = None,
+                      instant_events: Optional[List[Dict]] = None,
+                      process_names: Optional[Dict[int, str]] = None,
+                      include_buffer: bool = True):
     """Write the buffer (plus `extra_spans`, e.g. merged flight dumps) as
-    Chrome trace-event JSON — load in chrome://tracing or Perfetto."""
+    Chrome trace-event JSON — load in chrome://tracing or Perfetto.
+
+    Multi-process (add-only, telemetry/timeline.py export_perfetto):
+    `process_names` emits one process_name metadata row per pid so each
+    process gets a labelled track; `instant_events`
+    (``{"name", "t_wall", "pid", "args"}``) become instant marks (journal
+    frames, flight flushes); `include_buffer=False` exports ONLY the
+    supplied events — a whole-incident export must not mix in whatever
+    the exporting process's own span buffer happens to hold."""
     import json
 
     events = []
-    for rec in (extra_spans or []) + spans_snapshot():
+    for pid, pname in sorted((process_names or {}).items()):
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "tid": pid, "args": {"name": str(pname)}})
+    for inst in instant_events or []:
+        events.append({
+            "name": inst.get("name", ""),
+            "cat": "instant",
+            "ph": "i", "s": "p",
+            "ts": float(inst.get("t_wall", 0.0)) * 1e6,
+            "pid": inst.get("pid", 0),
+            "tid": inst.get("pid", 0),
+            "args": dict(inst.get("args") or {}),
+        })
+    buffered = spans_snapshot() if include_buffer else []
+    for rec in (extra_spans or []) + buffered:
         events.append({
             "name": rec["name"],
             "cat": rec.get("role", "proc"),

@@ -1,0 +1,410 @@
+"""Incident timeline: one causally-ordered observability plane.
+
+Pins the ADD-ONLY schemas (TIMELINE_EVENT_KEYS, the Timeline* message
+family, the flight envelope's anchor fields), the monotonic→wall
+anchoring under skewed process clocks, the (epoch, seq) causal order
+with nondecreasing-clamped wall times, cross-generation trace-tree
+merge with cumulative-re-flush dedup, byte-equal determinism of the
+assembler, the Perfetto export, the downtime-attribution narrative,
+and the tools/incident_report.py rc/sha contract.
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from dlrover_wuqiong_tpu.common import messages as msg
+from dlrover_wuqiong_tpu.master.journal import MasterJournal
+from dlrover_wuqiong_tpu.telemetry import (
+    TIMELINE_EVENT_KEYS,
+    TIMELINE_SCHEMA_VERSION,
+    FlightRecorder,
+    assemble_incident,
+    build_narrative,
+    export_perfetto,
+    incident_json,
+    incident_sha256,
+    trace_tree,
+)
+from dlrover_wuqiong_tpu.telemetry import timeline as tl
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write_dump(ckpt_dir, role, pid, flushed_at, flushed_mono, events,
+                ledger=None, serve_ledger=None, seq=1, reason="test"):
+    """A flight dump written straight in the envelope schema — the tests
+    need pids and clocks no single process could produce."""
+    out = os.path.join(ckpt_dir, "flight")
+    os.makedirs(out, exist_ok=True)
+    payload = {"schema": 1, "role": role, "pid": pid, "reason": reason,
+               "flushed_at": flushed_at, "flushed_mono": flushed_mono,
+               "ledger": ledger, "serve_ledger": serve_ledger,
+               "events": events}
+    path = os.path.join(out, f"{role}-{pid}-{reason}-{seq}.json")
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    return path
+
+
+def _span(trace_id, span_id, name, t_wall, t_mono, pid, role="worker",
+          parent=""):
+    return {"t_wall": t_wall, "t_mono": t_mono, "kind": "span",
+            "name": name,
+            "data": {"trace_id": trace_id, "span_id": span_id,
+                     "name": name, "parent_span": parent, "pid": pid,
+                     "role": role, "t_wall": t_wall, "dur_s": 0.5,
+                     "status": "ok", "attrs": {}}}
+
+
+# -------------------------------------------------------------- anchoring
+
+
+class TestAnchoring:
+    def test_skewed_wall_clock_is_corrected(self):
+        # the process's own wall clock is 50s fast; its monotonic clock
+        # plus the flush anchor pair recovers the TRUE wall time
+        dump = {"flushed_at": 1000.0, "flushed_mono": 400.0}
+        evt = {"t_wall": 1045.0, "t_mono": 395.0}  # wall lies, mono doesn't
+        assert tl.anchored_wall(dump, evt) == pytest.approx(995.0)
+
+    def test_two_skewed_processes_interleave_correctly(self, tmp_path):
+        # A's wall is +100s, B's is -7s; both flushed at true wall 1000.
+        # Anchoring must interleave their events by TRUE time: A@990,
+        # B@994, A@998 — the recorded t_wall order (1090, 987, 1098)
+        # would have said A, B, A too, but with garbage gaps; a third
+        # event pair proves the ORDER flips vs raw walls
+        ck = str(tmp_path)
+        _write_dump(ck, "workerA", 111, 1000.0, 500.0, [
+            {"t_wall": 1090.0, "t_mono": 490.0, "kind": "mark",
+             "name": "a-early", "data": {}},
+            {"t_wall": 1098.0, "t_mono": 498.0, "kind": "mark",
+             "name": "a-late", "data": {}}])
+        _write_dump(ck, "workerB", 222, 1000.0, 800.0, [
+            {"t_wall": 987.0, "t_mono": 794.0, "kind": "mark",
+             "name": "b-mid", "data": {}}])
+        events, _ = tl.read_flight_events(ck)
+        marks = [e for e in events if e["kind"] == "mark"]
+        assert [e["name"] for e in sorted(marks,
+                                          key=lambda e: e["t_wall"])] == \
+            ["a-early", "b-mid", "a-late"]
+        by_name = {e["name"]: e["t_wall"] for e in marks}
+        assert by_name["a-early"] == pytest.approx(990.0)
+        assert by_name["b-mid"] == pytest.approx(994.0)
+        assert by_name["a-late"] == pytest.approx(998.0)
+
+    def test_old_dump_without_anchor_falls_back_to_wall(self):
+        # pre-anchor dumps have no flushed_mono; pre-anchor events have
+        # no t_mono — both degrade to the recorded wall clock
+        assert tl.anchored_wall({"flushed_at": 1000.0},
+                                {"t_wall": 990.0}) == 990.0
+        assert tl.anchored_wall(
+            {"flushed_at": 1000.0, "flushed_mono": 1.0},
+            {"t_wall": 990.0}) == 990.0
+
+
+# ---------------------------------------------------------- journal order
+
+
+class TestJournalEvents:
+    def test_append_stamps_wall_ts(self, tmp_path):
+        j = MasterJournal(str(tmp_path / "j"), fsync=False)
+        j.append("register", {"node_id": 0})
+        j.close()
+        with open(tmp_path / "j" / "journal.frames", "rb") as f:
+            frames = [json.loads(ln) for ln in f.read().splitlines() if ln]
+        assert all("ts" in fr and fr["ts"] > 0 for fr in frames)
+
+    def test_ts_less_frames_tolerated(self, tmp_path):
+        # frames from a pre-ts journal replay fine: t_wall inherits the
+        # last seen wall, (epoch, seq) still orders them
+        jd = tmp_path / "j"
+        jd.mkdir()
+        with open(jd / "journal.frames", "w") as f:
+            f.write(json.dumps({"seq": 1, "kind": "epoch",
+                                "ts": 100.0,
+                                "data": {"epoch": 1}}) + "\n")
+            f.write(json.dumps({"seq": 2, "kind": "register",
+                                "data": {"node_id": 0}}) + "\n")
+        events = tl.read_journal_events(str(jd))
+        assert [(e["seq"], e["t_wall"]) for e in events] == \
+            [(1, 100.0), (2, 100.0)]
+
+    def test_regressing_wall_clamped_to_causal_order(self, tmp_path):
+        # a wall step backwards between master incarnations must not fold
+        # the merge order back over the journal's causal order
+        jd = tmp_path / "j"
+        jd.mkdir()
+        with open(jd / "journal.frames", "w") as f:
+            for seq, ts in ((1, 100.0), (2, 90.0), (3, 95.0)):
+                f.write(json.dumps({"seq": seq, "kind": "register",
+                                    "ts": ts, "data": {}}) + "\n")
+        events = tl.read_journal_events(str(jd))
+        walls = [e["t_wall"] for e in events]
+        assert walls == sorted(walls)
+        assert [e["seq"] for e in events] == [1, 2, 3]
+
+    def test_epoch_tagging_across_bump(self, tmp_path):
+        # two master incarnations on one journal, the real lifecycle:
+        # load() + open_epoch() per incarnation
+        jd = str(tmp_path / "j")
+        j = MasterJournal(jd, fsync=False)
+        j.load()
+        j.open_epoch()
+        j.append("register", {"node_id": 0})
+        j.close()
+        j2 = MasterJournal(jd, fsync=False)
+        j2.load()
+        j2.open_epoch()
+        j2.append("heartbeat", {"node_id": 0})
+        j2.close()
+        events = tl.read_journal_events(jd)
+        keys = [(e["epoch"], e["seq"]) for e in events]
+        assert keys == sorted(keys) and len(keys) == len(set(keys))
+        assert events[0]["epoch"] == 1
+        assert events[-1]["epoch"] == 2
+        assert [e["epoch"] for e in events] == [1, 1, 2, 2]
+
+
+# ------------------------------------------------------------ schema pins
+
+
+class TestAddOnlySchemas:
+    #: v1 event envelope — ADD-ONLY: the drills, incident_report and the
+    #: Perfetto export key on these; new keys append, never rename
+    V1_EVENT_KEYS = ("schema", "source", "kind", "name", "t_wall",
+                     "epoch", "seq", "role", "pid", "trace_id",
+                     "span_id", "dur_s", "data")
+
+    def test_event_keys_add_only(self):
+        for k in self.V1_EVENT_KEYS:
+            assert k in TIMELINE_EVENT_KEYS, f"removed event key {k!r}"
+        assert TIMELINE_SCHEMA_VERSION >= 1
+
+    def test_timeline_messages_add_only(self):
+        q = {f.name for f in dataclasses.fields(msg.TimelineQuery)}
+        assert {"node_id", "ckpt_dir"} <= q
+        r = {f.name for f in dataclasses.fields(msg.TimelineResponse)}
+        assert {"content", "events"} <= r
+
+    def test_timeline_query_never_journaled(self):
+        # POLLING class: a read-only assembly must not grow the journal
+        from dlrover_wuqiong_tpu.analysis.protocol_engine import (
+            IDEM_VERBS,
+            JOURNALED_VERBS,
+        )
+
+        assert "TimelineQuery" not in JOURNALED_VERBS
+        assert "TimelineQuery" not in IDEM_VERBS
+
+    def test_flight_envelope_anchor_fields(self, tmp_path):
+        rec = FlightRecorder()
+        rec.record("mark", "x", {})
+        path = rec.flush(str(tmp_path), "t")
+        with open(path) as f:
+            dump = json.load(f)
+        for key in ("schema", "role", "pid", "reason", "flushed_at",
+                    "flushed_mono", "ledger", "serve_ledger", "events"):
+            assert key in dump, f"removed envelope key {key!r}"
+        evt = dump["events"][0]
+        for key in ("t_wall", "t_mono", "kind", "name", "data"):
+            assert key in evt, f"removed event key {key!r}"
+
+    def test_event_builder_matches_pin(self):
+        e = tl._event("journal", "k", "n", 1.0)
+        assert tuple(e.keys()) == TIMELINE_EVENT_KEYS
+
+
+# --------------------------------------------------------------- assembly
+
+
+class TestAssembly:
+    def _fixture(self, tmp_path):
+        jd = str(tmp_path / "journal")
+        ck = str(tmp_path / "ckpt")
+        j = MasterJournal(jd, fsync=False)
+        j.load()
+        j.open_epoch()
+        j.append("register", {"node_id": 0})
+        j.close()
+        j2 = MasterJournal(jd, fsync=False)  # restarted master
+        j2.load()
+        j2.open_epoch()
+        j2.append("policy", {"decision": {"decision_id": 7,
+                                          "reason": "drill"}})
+        j2.close()
+        tid = "t" * 32
+        _write_dump(ck, "worker", 111, 1000.0, 500.0, [
+            _span(tid, "a" * 16, "serve:admit", 1090.0, 490.0, 111)],
+            ledger={"wall_s": 10.0, "states": {"productive": 8.0,
+                                               "degraded": 2.0}},
+            seq=1)
+        # generation 2: re-flush carries gen-1's admit span AGAIN
+        # (cumulative ring) plus its own child spans
+        _write_dump(ck, "worker", 222, 1002.0, 900.0, [
+            _span(tid, "a" * 16, "serve:admit", 1090.0, 890.0, 111),
+            _span(tid, "b" * 16, "serve:decode", 1001.0, 899.0, 222,
+                  parent="a" * 16),
+            _span(tid, "c" * 16, "serve:finish", 1001.5, 899.5, 222,
+                  parent="a" * 16)],
+            ledger={"wall_s": 5.0, "states": {"productive": 4.0,
+                                              "restore_storage": 1.0}},
+            seq=1)
+        return jd, ck, tid
+
+    def test_byte_equal_determinism(self, tmp_path):
+        jd, ck, _ = self._fixture(tmp_path)
+        a = incident_json(assemble_incident(journal_dir=jd, ckpt_dir=ck))
+        b = incident_json(assemble_incident(journal_dir=jd, ckpt_dir=ck))
+        assert a == b
+        assert incident_sha256(a) == incident_sha256(b)
+
+    def test_cross_generation_one_tree(self, tmp_path):
+        jd, ck, tid = self._fixture(tmp_path)
+        report = assemble_incident(journal_dir=jd, ckpt_dir=ck)
+        # dedup: the re-flushed admit span appears ONCE
+        spans = [e for e in report["events"] if e["kind"] == "span"]
+        assert len(spans) == 3
+        roots = trace_tree(report["events"], tid)
+        assert len(roots) == 1
+        root = roots[0]
+        assert root["name"] == "serve:admit"
+        assert sorted(c["name"] for c in root["children"]) == \
+            ["serve:decode", "serve:finish"]
+        # the tree joins TWO worker generations (pids)
+        pids = {root["pid"]} | {c["pid"] for c in root["children"]}
+        assert pids == {111, 222}
+
+    def test_events_json_safe_and_ordered(self, tmp_path):
+        jd, ck, _ = self._fixture(tmp_path)
+        report = assemble_incident(journal_dir=jd, ckpt_dir=ck)
+        json.dumps(report)  # no typed-JSON leftovers, no message objects
+        walls = [e["t_wall"] for e in report["events"]]
+        assert walls == sorted(walls)
+        jkeys = [(e["epoch"], e["seq"]) for e in report["events"]
+                 if e["source"] == "journal"]
+        assert jkeys == sorted(jkeys) and len(jkeys) == len(set(jkeys))
+
+    def test_counts(self, tmp_path):
+        jd, ck, _ = self._fixture(tmp_path)
+        c = assemble_incident(journal_dir=jd, ckpt_dir=ck)["counts"]
+        assert c["journal_events"] == 4  # fresh-epoch + 3 appends
+        assert c["spans"] == 3 and c["traces"] == 1
+        assert c["epochs"] == [1, 2]
+        assert c["processes"] == [["worker", 111], ["worker", 222]] or \
+            c["processes"] == [("worker", 111), ("worker", 222)]
+
+
+# -------------------------------------------------------------- narrative
+
+
+class TestNarrative:
+    def test_attribution_and_policy_answer(self, tmp_path):
+        jd = str(tmp_path / "j")
+        j = MasterJournal(jd, fsync=False)
+        j.append("epoch", {"epoch": 2})            # master restart
+        j.append("recover", {"node_id": 3})        # worker failure
+        j.append("policy", {"decision": {"decision_id": 9,
+                                         "reason": "raise-cadence"}})
+        j.close()
+        ledgers = [{"role": "worker", "pid": 1, "ledger": {
+            "wall_s": 20.0,
+            "states": {"productive": 15.0, "degraded": 2.5,
+                       "restore_storage": 1.0, "rework": 0.5}}}]
+        narr = build_narrative(tl.read_journal_events(jd), ledgers)
+        kinds = {i["kind"]: i for i in narr["incidents"]}
+        assert kinds["master_restart"]["attributed_state"] == "degraded"
+        assert kinds["master_restart"]["lost_s"] == pytest.approx(2.5)
+        assert kinds["worker_failure"]["attributed_state"] == "restore"
+        assert kinds["worker_failure"]["lost_s"] == pytest.approx(1.5)
+        for i in narr["incidents"]:
+            assert i["policy_response"]["decision_id"] == 9
+        assert narr["productive_s"] == pytest.approx(15.0)
+        assert narr["goodput_fraction"] == pytest.approx(15.0 / 20.0)
+
+    def test_no_incident_without_trigger(self, tmp_path):
+        jd = str(tmp_path / "j")
+        j = MasterJournal(jd, fsync=False)
+        j.append("register", {"node_id": 0})
+        j.close()
+        narr = build_narrative(tl.read_journal_events(jd), [])
+        assert narr["incidents"] == []
+        assert narr["policy_decisions"] == 0
+
+
+# --------------------------------------------------------------- perfetto
+
+
+class TestPerfettoExport:
+    def test_export_contains_processes_spans_instants(self, tmp_path):
+        jd = str(tmp_path / "journal")
+        ck = str(tmp_path / "ckpt")
+        j = MasterJournal(jd, fsync=False)
+        j.append("register", {"node_id": 0})
+        j.close()
+        tid = "t" * 32
+        _write_dump(ck, "worker", 111, 1000.0, 500.0, [
+            _span(tid, "a" * 16, "serve:admit", 999.0, 499.0, 111),
+            {"t_wall": 999.5, "t_mono": 499.5, "kind": "mark",
+             "name": "m", "data": {}}])
+        report = assemble_incident(journal_dir=jd, ckpt_dir=ck)
+        out = str(tmp_path / "trace.json")
+        n = export_perfetto(report, out)
+        assert n > 0
+        with open(out) as f:
+            rows = json.load(f)["traceEvents"]
+        phases = {r["ph"] for r in rows}
+        assert {"M", "X", "i"} <= phases
+        meta = {r["pid"]: r["args"]["name"] for r in rows
+                if r["ph"] == "M"}
+        assert meta[0] == "master(journal)"
+        assert meta[111] == "worker"
+
+
+# ------------------------------------------------------------ CLI contract
+
+
+class TestIncidentReportCLI:
+    def _run(self, *args, env_extra=None):
+        env = {k: v for k, v in os.environ.items()
+               if k != "DWT_MASTER_ADDR"}
+        env["JAX_PLATFORMS"] = "cpu"
+        env.update(env_extra or {})
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "incident_report.py"), *args],
+            capture_output=True, text=True, env=env, timeout=120)
+
+    def test_no_addr_rc2(self):
+        p = self._run()
+        assert p.returncode == 2
+        assert "error" in json.loads(p.stdout)
+
+    def test_help_rc0_stdout_clean(self):
+        p = self._run("-h")
+        assert p.returncode == 0
+        assert p.stdout.strip() == ""
+        assert "incident" in p.stderr.lower()
+
+    def test_offline_sha_matches_assembler(self, tmp_path):
+        jd = str(tmp_path / "j")
+        j = MasterJournal(jd, fsync=False)
+        j.append("epoch", {"epoch": 2})
+        j.close()
+        content = incident_json(assemble_incident(journal_dir=jd))
+        p = self._run("--journal", jd)
+        assert p.returncode == 0, p.stdout + p.stderr
+        line = json.loads(p.stdout)
+        assert line["timeline_sha256"] == incident_sha256(content)
+        assert line["source"] == "disk"
+        assert line["events"] == line["journal_events"] > 0
+        assert line["incidents"] == 1
+
+    def test_bad_journal_rc1(self, tmp_path):
+        p = self._run("--journal", str(tmp_path / "missing"))
+        assert p.returncode == 1
+        assert "error" in json.loads(p.stdout)

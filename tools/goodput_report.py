@@ -22,7 +22,6 @@ Fields: states (seconds per ledger state), wall_s, other_s (residual),
 goodput_fraction, nodes (reporting processes), plus source bookkeeping.
 """
 
-import json
 import os
 import sys
 
@@ -96,33 +95,16 @@ def _from_flight(ckpt_dir: str) -> dict:
 
 
 def main(argv=None) -> int:
-    argv = argv if argv is not None else sys.argv[1:]
-    flight = addr = None
-    it = iter(argv)
-    for a in it:
-        if a == "--flight":
-            flight = next(it, None)
-        elif a == "--addr":
-            addr = next(it, None)
-        elif a in ("-h", "--help"):
-            print(__doc__, file=sys.stderr)
-            return 0
-    try:
-        if flight:
-            report = _from_flight(flight)
-        else:
-            addr = addr or os.getenv("DWT_MASTER_ADDR", "")
-            if not addr:
-                print(json.dumps({"error": "no master address: pass "
-                                  "--addr, set DWT_MASTER_ADDR, or use "
-                                  "--flight CKPT_DIR"}))
-                return 2
-            report = _from_master(addr)
-    except Exception as e:  # noqa: BLE001 — the JSON contract beats purity
-        print(json.dumps({"error": repr(e)[:500]}))
-        return 1
-    print(json.dumps(report))
-    return 0
+    from dlrover_wuqiong_tpu.common.report_cli import run_report
+
+    return run_report(
+        argv, __doc__,
+        offline=lambda v: (_from_flight(v["--flight"])
+                           if v.get("--flight") else None),
+        live=lambda addr, v: _from_master(addr),
+        no_addr_error="no master address: pass --addr, set "
+                      "DWT_MASTER_ADDR, or use --flight CKPT_DIR",
+        value_flags=("--flight",))
 
 
 if __name__ == "__main__":

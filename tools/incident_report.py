@@ -1,0 +1,125 @@
+"""Incident-timeline report: ONE JSON line for the driver/operator.
+
+Two sources, ONE byte-identical timeline (telemetry/timeline.py):
+
+    python tools/incident_report.py [--addr HOST:PORT] [--ckpt DIR]
+    python tools/incident_report.py --journal DIR [--flight CKPT_DIR]
+
+Live mode asks the master (TimelineQuery, POLLING class) to assemble
+the incident timeline from its own journal directory plus the flight
+dumps under ``--ckpt`` (falls back to ``--flight`` when only that is
+given).  Offline mode runs the SAME assembler over disk artifacts
+alone — a post-mortem needs no process alive.  Because the assembler
+is a pure function of the artifacts, the two sources produce
+byte-equal canonical JSON; ``timeline_sha256`` in the summary line is
+the proof handle (the chaos drills diff it across live/offline).
+
+Optional sinks (paths, both write full artifacts next to the 1-line
+summary): ``--events-out FILE`` writes the canonical incident JSON;
+``--perfetto FILE`` writes a chrome://tracing / Perfetto trace of the
+whole incident (spans from every process + journal instants).
+
+Summary fields: source bookkeeping, event/span/trace/epoch/process
+counts, incidents with per-incident lost seconds, goodput_fraction,
+and timeline_sha256.  Exit/error contract matches the other report
+tools (common/report_cli.py): one JSON line ALWAYS, rc=2 missing
+address, rc=1 failure, rc=0 success.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _summarize(content: str, src: dict) -> dict:
+    from dlrover_wuqiong_tpu.telemetry import incident_sha256
+
+    report = json.loads(content)
+    counts = report.get("counts", {})
+    narr = report.get("narrative", {})
+    incidents = narr.get("incidents", [])
+    line = dict(src)
+    line.update({
+        "schema": report.get("schema"),
+        "events": counts.get("events", 0),
+        "journal_events": counts.get("journal_events", 0),
+        "flight_events": counts.get("flight_events", 0),
+        "spans": counts.get("spans", 0),
+        "traces": counts.get("traces", 0),
+        "epochs": len(counts.get("epochs", [])),
+        "processes": len(counts.get("processes", [])),
+        "incidents": len(incidents),
+        "lost_s": round(sum(float(i.get("lost_s", 0.0))
+                            for i in incidents), 3),
+        "goodput_fraction": narr.get("goodput_fraction"),
+        "policy_decisions": narr.get("policy_decisions", 0),
+        "timeline_sha256": incident_sha256(content),
+    })
+    return line
+
+
+def _sinks(content: str, vals: dict) -> None:
+    from dlrover_wuqiong_tpu.telemetry import export_perfetto
+
+    out = vals.get("--events-out")
+    if out:
+        with open(out, "w", encoding="utf-8") as f:
+            f.write(content)
+    perf = vals.get("--perfetto")
+    if perf:
+        export_perfetto(json.loads(content), perf)
+
+
+def _from_disk(vals: dict) -> dict:
+    from dlrover_wuqiong_tpu.telemetry import assemble_incident, incident_json
+
+    journal = vals.get("--journal") or ""
+    flight = vals.get("--flight") or ""
+    if journal and not os.path.isdir(journal):
+        raise FileNotFoundError(
+            f"--journal: {journal!r} is not a directory")
+    if flight and not os.path.isdir(flight):
+        raise FileNotFoundError(
+            f"--flight: {flight!r} is not a directory")
+    content = incident_json(assemble_incident(journal_dir=journal,
+                                              ckpt_dir=flight))
+    _sinks(content, vals)
+    return _summarize(content, {"source": "disk", "journal_dir": journal,
+                                "ckpt_dir": flight})
+
+
+def _from_master(addr: str, vals: dict) -> dict:
+    from dlrover_wuqiong_tpu.agent.master_client import MasterClient
+
+    ckpt = vals.get("--ckpt") or vals.get("--flight") or ""
+    mc = MasterClient(addr, node_id=-1)
+    try:
+        resp = mc.get_timeline(ckpt_dir=ckpt)
+    finally:
+        mc.close()
+    _sinks(resp.content, vals)
+    return _summarize(resp.content, {"source": "master", "addr": addr,
+                                     "ckpt_dir": ckpt})
+
+
+def main(argv=None) -> int:
+    from dlrover_wuqiong_tpu.common.report_cli import run_report
+
+    return run_report(
+        argv, __doc__,
+        offline=lambda v: (_from_disk(v)
+                           if (v.get("--journal") or v.get("--flight"))
+                           else None),
+        live=_from_master,
+        no_addr_error="no master address: pass --addr, set "
+                      "DWT_MASTER_ADDR, or use --journal DIR "
+                      "[--flight CKPT_DIR]",
+        value_flags=("--journal", "--flight", "--ckpt",
+                     "--perfetto", "--events-out"))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

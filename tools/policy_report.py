@@ -16,7 +16,6 @@ Fields: current (knob dict or null), history_len, decision ids, and the
 latest preemption-rate/reason context.
 """
 
-import json
 import os
 import sys
 
@@ -70,33 +69,16 @@ def _from_journal(journal_dir: str) -> dict:
 
 
 def main(argv=None) -> int:
-    argv = argv if argv is not None else sys.argv[1:]
-    journal = addr = None
-    it = iter(argv)
-    for a in it:
-        if a == "--journal":
-            journal = next(it, None)
-        elif a == "--addr":
-            addr = next(it, None)
-        elif a in ("-h", "--help"):
-            print(__doc__, file=sys.stderr)
-            return 0
-    try:
-        if journal:
-            report = _from_journal(journal)
-        else:
-            addr = addr or os.getenv("DWT_MASTER_ADDR", "")
-            if not addr:
-                print(json.dumps({"error": "no master address: pass "
-                                  "--addr, set DWT_MASTER_ADDR, or use "
-                                  "--journal DIR"}))
-                return 2
-            report = _from_master(addr)
-    except Exception as e:  # noqa: BLE001 — the JSON contract beats purity
-        print(json.dumps({"error": repr(e)[:500]}))
-        return 1
-    print(json.dumps(report))
-    return 0
+    from dlrover_wuqiong_tpu.common.report_cli import run_report
+
+    return run_report(
+        argv, __doc__,
+        offline=lambda v: (_from_journal(v["--journal"])
+                           if v.get("--journal") else None),
+        live=lambda addr, v: _from_master(addr),
+        no_addr_error="no master address: pass --addr, set "
+                      "DWT_MASTER_ADDR, or use --journal DIR",
+        value_flags=("--journal",))
 
 
 if __name__ == "__main__":

@@ -15,7 +15,6 @@ rc=2 with an ``error`` field, any failure is rc=1 with an ``error``
 field, never a raw traceback on stdout.
 """
 
-import json
 import os
 import sys
 
@@ -52,27 +51,14 @@ def _from_master(addr: str) -> dict:
 
 
 def main(argv=None) -> int:
-    argv = argv if argv is not None else sys.argv[1:]
-    addr = None
-    it = iter(argv)
-    for a in it:
-        if a == "--addr":
-            addr = next(it, None)
-        elif a in ("-h", "--help"):
-            print(__doc__, file=sys.stderr)
-            return 0
-    try:
-        addr = addr or os.getenv("DWT_MASTER_ADDR", "")
-        if not addr:
-            print(json.dumps({"error": "no master address: pass --addr "
-                              "or set DWT_MASTER_ADDR"}))
-            return 2
-        report = _from_master(addr)
-    except Exception as e:  # noqa: BLE001 — the JSON contract beats purity
-        print(json.dumps({"error": repr(e)[:500]}))
-        return 1
-    print(json.dumps(report))
-    return 0
+    from dlrover_wuqiong_tpu.common.report_cli import run_report
+
+    return run_report(
+        argv, __doc__,
+        offline=lambda v: None,
+        live=lambda addr, v: _from_master(addr),
+        no_addr_error="no master address: pass --addr "
+                      "or set DWT_MASTER_ADDR")
 
 
 if __name__ == "__main__":
