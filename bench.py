@@ -541,12 +541,20 @@ def _traced_window(res, cfg, batch, seq, state, trace_dir, steps=3):
                     # must contain the device work it claims to time
     finally:
         prof.close()
+    # the same executable identity the trainer's perf observatory keys
+    # its baseline store by — a bench trace is comparable to in-train
+    # PerfSnapshots only within one key (telemetry/perf.py)
+    from dlrover_wuqiong_tpu.telemetry.perf import executable_key
+
+    key = executable_key(repr(getattr(res, "strategy_spec", None)), 1,
+                         jax.default_backend())
     if prof.last_profile is None:
-        return {"trace_dir": trace_dir,
+        return {"trace_dir": trace_dir, "perf_key": key,
                 "trace_error": "xplane parse yielded no op events"}
     p = prof.last_profile
     return {
         "trace_dir": trace_dir,
+        "perf_key": key,
         "trace_steps": steps,
         "device_op_categories": {k: round(v, 6)
                                  for k, v in sorted(p.categories.items())},

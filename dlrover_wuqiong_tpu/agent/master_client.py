@@ -372,6 +372,21 @@ class MasterClient:
         """Job-level ledger aggregation (tools/goodput_report.py)."""
         return self._call_polling("get", msg.GoodputQuery())
 
+    def report_perf_snapshot(self, snapshot: Dict):
+        """Push the latest perf-observatory snapshot (telemetry/perf.py)
+        — BUFFERED like the goodput ledger: the snapshot carries
+        cumulative counters, so the master keeping latest-SENT per node
+        makes drops and replays harmless."""
+        return self._call_buffered(
+            msg.PerfSnapshotReport(node_id=self.node_id,
+                                   snapshot=dict(snapshot),
+                                   sent_at=time.time()),
+            default=msg.OkResponse())
+
+    def get_perf_summary(self) -> msg.PerfSummary:
+        """Job-level perf aggregation (tools/perf_report.py)."""
+        return self._call_polling("get", msg.PerfQuery())
+
     # ------------------------------------------------------ adaptive policy
 
     def report_policy_decision(self, decision: msg.PolicyDecision

@@ -102,15 +102,32 @@ def _install_listeners() -> None:
         _listeners_installed = True
         return
 
+    def _export(name: str, value: float = 1.0):
+        # mirror into the shared Prometheus registry so /metrics and the
+        # perf observatory's compile/retrace watcher see the same stream
+        # the in-process counters do (lazy import: this module stays
+        # importable without the master package at module level)
+        try:
+            from ..master.metrics import get_registry
+
+            get_registry().inc(
+                name, value,
+                help="XLA persistent compile cache (auto/compile_cache)")
+        except Exception:  # noqa: BLE001 — telemetry never breaks compiles
+            pass
+
     def _on_event(name: str, **kw):
         if name.endswith("/cache_hits"):
             counters.hits += 1
+            _export("dwt_compile_cache_hits")
         elif name.endswith("/cache_misses"):
             counters.misses += 1
+            _export("dwt_compile_cache_misses")
 
     def _on_duration(name: str, secs: float, **kw):
         if name.endswith("/compile_time_saved_sec") and secs > 0:
             counters.time_saved_s += secs
+            _export("dwt_compile_cache_time_saved_seconds", secs)
 
     monitoring.register_event_listener(_on_event)
     monitoring.register_event_duration_secs_listener(_on_duration)

@@ -234,6 +234,9 @@ class PolicyEngine:
         self._clock = clock
         self._last_summary: Dict = {}
         self._last_emitted: Optional[msg.PolicyDecision] = None
+        self._last_perf: Optional[Dict] = None
+        self._perf_before: Optional[Dict] = None
+        self._perf_after: Optional[Dict] = None
 
     # ------------------------------------------------------------- inputs
 
@@ -245,6 +248,31 @@ class PolicyEngine:
         knob math keys off the failure regime, not the fraction)."""
         if isinstance(summary, dict):
             self._last_summary = summary
+
+    def observe_perf(self, summary: Dict):
+        """Latest job-level perf aggregation (telemetry/perf.py via the
+        master's PerfSummary) — the MEASURED before/after for decision-
+        effect attribution (ROADMAP 5b): the summary observed before a
+        decision is frozen as its "before" side, and subsequent
+        observations become the "after", exposed by decision_effect().
+        """
+        if not isinstance(summary, dict):
+            return
+        self._last_perf = summary
+        if self._last_emitted is not None and self._perf_before is not None:
+            self._perf_after = summary
+
+    def decision_effect(self) -> Dict:
+        """Measured perf around the last emitted decision:
+        ``{"decision_id", "before", "after"}`` (empty dict until both
+        sides exist).  Pure read — attribution lives with the operator
+        (tools/policy_report.py), not in the knob math."""
+        if self._last_emitted is None or self._perf_before is None \
+                or self._perf_after is None:
+            return {}
+        return {"decision_id": self._last_emitted.decision_id,
+                "before": dict(self._perf_before),
+                "after": dict(self._perf_after)}
 
     # ------------------------------------------------------------ decisions
 
@@ -298,9 +326,18 @@ class PolicyEngine:
         d = self.propose(now)
         if not self._materially_different(d):
             return None
+        self._note_decision_perf()
         self._last_emitted = d
         return d
 
     def note_emitted(self, d: msg.PolicyDecision):
         """Sync hysteresis baseline to an externally admitted decision."""
+        if d is not self._last_emitted:
+            self._note_decision_perf()
         self._last_emitted = d
+
+    def _note_decision_perf(self):
+        """Freeze the latest perf observation as the new decision's
+        "before" side; the "after" fills on the next observe_perf."""
+        self._perf_before = self._last_perf
+        self._perf_after = None

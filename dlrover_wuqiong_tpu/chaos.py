@@ -2284,6 +2284,99 @@ def serve_drain(n_requests: int = 8, max_new_tokens: int = 24,
                                            for k, v in tails.items()})
 
 
+def perf_regress() -> Dict:
+    """Perf-regression sentinel drill (telemetry/perf.py) — jax-free.
+
+    Three invariants, all on the REAL BaselineStore + RegressionSentinel
+    (seeded synthetic windows, so the drill is hermetic and fast):
+
+    1. QUIET: shared-tunnel-scale +-10% noise around the baseline never
+       fires — the MAD bound absorbs normal drift.
+    2. THROTTLED: a sustained ~1.5x step-time slowdown whose extra wall
+       sits in the collective category fires `perf-regression` after
+       EXACTLY M consecutive beyond-bound windows, once per excursion,
+       and attributes the moved category.
+    3. KEY ISOLATION: flipping a TRACE_ENV_VARS toggle changes the
+       executable key (a different executable is a new baseline, never a
+       false regression), and the published store survives an atomic
+       write + reload round-trip with identical stats.
+    """
+    import random
+    import shutil
+
+    from .telemetry.perf import (BaselineStore, RegressionSentinel,
+                                 executable_key)
+
+    work = tempfile.mkdtemp(prefix="dwt-chaos-perfregress-")
+    report: Dict = {"scenario": "perf-regress", "ok": False}
+    try:
+        m_consec = 3
+        store = BaselineStore(
+            path=os.path.join(work, "perf", "baseline.json"))
+        sentinel = RegressionSentinel(store, m_consecutive=m_consec)
+        key = executable_key("drill-fingerprint", 8, "cpu")
+        rng = random.Random(1234)
+
+        def window(v, coll_frac):
+            cats = {"matmul": v * (1 - coll_frac),
+                    "collective": v * coll_frac}
+            beyond, event = sentinel.observe(key, v, cats, step=window.n)
+            window.n += 8
+            if not beyond:
+                store.update(key, v, cats)
+                store.publish()
+            return event
+        window.n = 0
+
+        # 1) quiet phase: baseline forms, nothing fires
+        quiet_events = [e for _ in range(16)
+                        if (e := window(0.1 * (1 + 0.1 * (
+                            rng.random() * 2 - 1)), 0.3)) is not None]
+        # 2) throttled phase: +60% wall, all of it collective
+        fired = []
+        for i in range(2 * m_consec):
+            e = window(0.16, 0.56)
+            if e is not None:
+                fired.append((i + 1, e))
+        # 3) key isolation across a trace-env flip + store round-trip
+        env_var = "DWT_FA_NO_FUSED"
+        saved = os.environ.get(env_var)
+        try:
+            os.environ[env_var] = "1"
+            flipped = executable_key("drill-fingerprint", 8, "cpu")
+        finally:
+            if saved is None:
+                os.environ.pop(env_var, None)
+            else:
+                os.environ[env_var] = saved
+        reloaded = BaselineStore(
+            path=os.path.join(work, "perf", "baseline.json"))
+        report.update(
+            quiet_events=len(quiet_events),
+            fired_after_windows=fired[0][0] if fired else -1,
+            fired_total=len(fired),
+            fired_kind=fired[0][1]["kind"] if fired else "",
+            attributed_category=fired[0][1]["category"] if fired else "",
+            key_changed_on_env_flip=flipped != key,
+            baseline_roundtrip=reloaded.stats(key) == store.stats(key)
+            and store.stats(key) is not None,
+        )
+        report["ok"] = (
+            not quiet_events
+            and len(fired) == 1
+            and fired[0][0] == m_consec
+            and fired[0][1]["kind"] == "perf-regression"
+            and fired[0][1]["category"] == "collective"
+            and report["key_changed_on_env_flip"]
+            and report["baseline_roundtrip"])
+        return report
+    finally:
+        if report.get("ok"):
+            shutil.rmtree(work, ignore_errors=True)
+        else:
+            report["workdir"] = work
+
+
 SCENARIOS = {"pod-kill": pod_kill, "straggler": straggler,
              "network-partition": network_partition,
              "preempt": preempt, "preempt-table": preempt_table,
@@ -2292,7 +2385,8 @@ SCENARIOS = {"pod-kill": pod_kill, "straggler": straggler,
              "preempt-adaptive": preempt_adaptive,
              "ckpt-corrupt": ckpt_corrupt,
              "master-kill": master_kill,
-             "serve-drain": serve_drain}
+             "serve-drain": serve_drain,
+             "perf-regress": perf_regress}
 
 
 def main(argv=None):

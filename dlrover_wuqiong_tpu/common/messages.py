@@ -268,6 +268,41 @@ class GoodputSummary:
     nodes: int = 0
 
 
+@message
+class PerfSnapshotReport:
+    """Latest per-node perf-observatory snapshot (telemetry/perf.py).
+
+    BUFFERED and NEVER journaled (pure telemetry — the goodput-report
+    pattern): the ``snapshot`` dict carries cumulative counters plus the
+    latest window, so drops and replays are harmless; the master keeps
+    the latest-SENT per node.  ``snapshot`` keys are the ADD-ONLY
+    ``PERF_SNAPSHOT_KEYS`` schema.
+    """
+
+    node_id: int = -1
+    snapshot: Dict = field(default_factory=dict)
+    # send-time wall stamp — same latest-SENT-wins hazard as
+    # GoodputLedgerReport (the degraded buffer drains AFTER reconnect)
+    sent_at: float = 0.0
+
+
+@message
+class PerfQuery:
+    """Pull the job-level perf aggregation (tools/perf_report.py)."""
+
+    pass
+
+
+@message
+class PerfSummary:
+    """Per-node latest snapshots + job-level regression/retrace totals."""
+
+    snapshots: Dict[str, Dict] = field(default_factory=dict)
+    regressions: int = 0
+    retraces: int = 0
+    nodes: int = 0
+
+
 # ---------------------------------------------------------------- kv store
 
 

@@ -159,6 +159,33 @@ class DiagnosisDataManager:
                 return ""
             return content
 
+    # evidence JSON keys derived from a PerfSnapshot — ADD-ONLY (pinned
+    # by tests/test_perf.py: ResolveHangCauseOperator and operators yet
+    # to come read these names out of node_op_profile content)
+    PERF_EVIDENCE_KEYS = ("source", "step", "key", "step_time_s",
+                         "categories")
+
+    def store_perf_snapshot(self, node_id: int, snapshot: Dict):
+        """Fold a perf-observatory snapshot (telemetry/perf.py
+        PERF_SNAPSHOT_KEYS dict) into the SAME op-profile store the
+        worker-pushed ``op_profile`` DiagnosisReport lands in — the
+        master keeps ONE source of truth for "where device time goes",
+        whether it arrived as diagnosis evidence or perf telemetry."""
+        if not isinstance(snapshot, dict) or not snapshot.get("categories"):
+            return
+        evidence = json.dumps({
+            "source": "perf_snapshot",
+            "step": int(snapshot.get("step", -1)),
+            "key": str(snapshot.get("key", "")),
+            "step_time_s": float(snapshot.get("step_time_s", 0.0)),
+            "categories": {str(k): float(v) for k, v in
+                           sorted(snapshot.get("categories", {}).items())},
+        })
+        with self._lock:
+            self._op_profiles[node_id] = (
+                float(snapshot.get("captured_at", 0.0)) or time.time(),
+                evidence)
+
 
 # --------------------------------------------------------------- operators
 

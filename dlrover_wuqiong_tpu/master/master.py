@@ -96,6 +96,7 @@ class JobMaster:
         self._custom_metrics: Dict = {}
         self._node_events: list = []
         self._goodput: Dict[int, msg.GoodputLedgerReport] = {}
+        self._perf: Dict[int, msg.PerfSnapshotReport] = {}
         self._paral_config = msg.ParallelConfig()
         # ---------------------------------------------- adaptive policy
         # brain/policy.py closed loop: decisions live here (journaled as
@@ -398,6 +399,52 @@ class JobMaster:
             goodput_fraction=(productive / total) if total > 0 else 0.0,
             nodes=len(self._goodput))
 
+    # ---------------------------------------------------------------- perf
+
+    def collect_perf(self, report: msg.PerfSnapshotReport):
+        """Latest-SENT-wins per-node perf snapshot (BUFFERED verb, same
+        drain-ordering hazard as collect_goodput).
+
+        Also the satellite feed for diagnosis: the snapshot's op-category
+        split lands in DiagnosisDataManager's op-profile store, so hang
+        resolution and the perf observatory read ONE source of truth."""
+        prev = self._perf.get(report.node_id)
+        if prev is not None and getattr(prev, "sent_at", 0.0) > \
+                getattr(report, "sent_at", 0.0) > 0.0:
+            return
+        self._perf[report.node_id] = report
+        snap = report.snapshot or {}
+        try:
+            self.diagnosis_manager.data.store_perf_snapshot(
+                report.node_id, snap)
+        except Exception:  # noqa: BLE001 — telemetry must never kill rpc
+            logger.exception("perf snapshot → diagnosis store failed")
+        labels = {"job": self.metric_collector.job,
+                  "node": str(report.node_id)}
+        for name, key in (("dwt_perf_step_seconds", "step_time_s"),
+                          ("dwt_perf_baseline_median_seconds",
+                           "baseline_median_s"),
+                          ("dwt_perf_overhead_fraction", "overhead_frac")):
+            try:
+                self.metric_collector.reg.gauge(
+                    name, float(snap.get(key, 0.0)), labels,
+                    help="perf-observatory window stats "
+                         "(telemetry/perf.py)")
+            except (TypeError, ValueError):
+                pass
+
+    def perf_summary(self) -> msg.PerfSummary:
+        """Job-level view: latest snapshot per node + event totals."""
+        snapshots = {str(nid): dict(rep.snapshot or {})
+                     for nid, rep in self._perf.items()}
+        return msg.PerfSummary(
+            snapshots=snapshots,
+            regressions=sum(int(s.get("regressions", 0))
+                            for s in snapshots.values()),
+            retraces=sum(int(s.get("retraces", 0))
+                         for s in snapshots.values()),
+            nodes=len(snapshots))
+
     # ------------------------------------------------------------- serving
 
     def collect_serve_stats(self, report: msg.ServeStatsReport):
@@ -487,6 +534,17 @@ class JobMaster:
             eng.observe_goodput({
                 "goodput_fraction": s.goodput_fraction,
                 "wall_s": s.wall_s, "nodes": s.nodes})
+            p = self.perf_summary()
+            if p.nodes:
+                # measured step time per node → decision-effect
+                # attribution (brain/policy.py observe_perf keeps the
+                # before/after around each emitted decision)
+                eng.observe_perf({
+                    "step_time_s": {
+                        nid: float(snap.get("step_time_s", 0.0))
+                        for nid, snap in p.snapshots.items()},
+                    "regressions": p.regressions,
+                    "retraces": p.retraces, "nodes": p.nodes})
             decision = eng.maybe_decide()
             if decision is None:
                 return

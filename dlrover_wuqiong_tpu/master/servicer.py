@@ -158,6 +158,9 @@ class MasterServicer:
         if isinstance(payload, msg.GoodputQuery):
             return m.goodput_summary()
 
+        if isinstance(payload, msg.PerfQuery):
+            return m.perf_summary()
+
         if isinstance(payload, msg.ServeLeaseRequest):
             leased = m.serve_queue.lease(payload.node_id,
                                          payload.max_requests)
@@ -358,6 +361,12 @@ class MasterServicer:
             # pure telemetry (cumulative snapshot, latest-wins) — no
             # journal frame; a master restart just waits for the next one
             m.collect_goodput(payload)
+            return msg.OkResponse()
+
+        if isinstance(payload, msg.PerfSnapshotReport):
+            # pure telemetry (cumulative counters, latest-SENT-wins) —
+            # no journal frame, same contract as GoodputLedgerReport
+            m.collect_perf(payload)
             return msg.OkResponse()
 
         if isinstance(payload, msg.PolicyDecisionReport):

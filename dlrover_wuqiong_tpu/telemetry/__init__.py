@@ -20,9 +20,16 @@ plus the master journal into ONE causally-ordered event stream — live
 via the TimelineQuery verb, offline via tools/incident_report.py,
 byte-equal either way.
 
+The perf observatory (telemetry/perf.py) adds the device-side signal the
+ledger cannot see: sampled in-train profiling windows keyed by
+executable identity, a median+MAD baseline store under
+``$ckpt_dir/perf/``, and a regression/retrace sentinel feeding node
+events, the policy loop and tools/perf_report.py.
+
 Schemas are ADD-ONLY: ``LEDGER_STATES``, the ledger snapshot keys, the
-flight-dump envelope keys (tests/test_telemetry.py) and the timeline
-event envelope (tests/test_timeline.py) — extend, never rename.
+flight-dump envelope keys (tests/test_telemetry.py), the timeline
+event envelope (tests/test_timeline.py) and the PerfSnapshot /
+perf-event keys (tests/test_perf.py) — extend, never rename.
 """
 
 from .ledger import (  # noqa: F401
@@ -39,6 +46,19 @@ from .serving import (  # noqa: F401
     ServeLedger,
     get_serve_ledger,
     reset_serve_ledger,
+)
+from .perf import (  # noqa: F401
+    PERF_EVENT_KEYS,
+    PERF_SCHEMA,
+    PERF_SNAPSHOT_KEYS,
+    BaselineStore,
+    PerfObservatory,
+    RegressionSentinel,
+    executable_key,
+    get_observatory,
+    latest_snapshot,
+    reset_observatory,
+    set_observatory,
 )
 from .recorder import (  # noqa: F401
     FLIGHT_SCHEMA_VERSION,

@@ -48,6 +48,8 @@ LEDGER_STATES = (
     "compile",           # first dispatch of a fused program (trace+XLA)
     "rework",            # re-executing steps already done pre-rollback
     "degraded",          # blocked on master RPCs during an outage
+    "profile",           # perf-observatory window overhead (trace
+                         # start/stop + xplane parse — telemetry/perf.py)
 )
 
 LEDGER_SCHEMA_VERSION = 1

@@ -13,7 +13,9 @@ Dump layout (ADD-ONLY schema, pinned by tests/test_telemetry.py):
     $ckpt_dir/flight/<role>-<pid>-<reason>-<seq>.json
     {"schema": 1, "role", "pid", "reason", "flushed_at", "flushed_mono",
      "ledger": <ledger snapshot or null>,
-     "serve_ledger": <serve-ledger snapshot or null>, "events": [...]}
+     "serve_ledger": <serve-ledger snapshot or null>,
+     "perf": <latest PerfSnapshot or null — telemetry/perf.py>,
+     "events": [...]}
 
 Events are ``{"t_wall", "t_mono", "kind", "name", "data"}``; ``kind`` is
 one of span | node_event | state | mark.  Spans recorded here carry
@@ -83,6 +85,7 @@ class FlightRecorder:
             return None
         try:
             from .ledger import get_ledger
+            from .perf import latest_snapshot as latest_perf_snapshot
             from .serving import get_serve_ledger
             from .spans import process_role
 
@@ -109,6 +112,7 @@ class FlightRecorder:
                 "serve_ledger": (get_serve_ledger().snapshot()
                                  if get_serve_ledger().started()
                                  else None),
+                "perf": latest_perf_snapshot(),
                 "events": self.snapshot(),
             }
             tmp = f"{path}.tmp"

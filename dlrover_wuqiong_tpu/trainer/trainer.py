@@ -72,6 +72,13 @@ class TrainingArgs:
             raise ValueError(
                 f"fused_steps must be >= 0 (0 = auto-tune), got "
                 f"{self.fused_steps}")
+        if self.perf_window_every < 0 or self.perf_regress_windows < 1 \
+                or not 0.0 < self.perf_overhead_budget <= 1.0:
+            raise ValueError(
+                f"bad perf-observatory knobs: perf_window_every="
+                f"{self.perf_window_every} (>= 0), perf_regress_windows="
+                f"{self.perf_regress_windows} (>= 1), perf_overhead_budget="
+                f"{self.perf_overhead_budget} (in (0, 1])")
     profile_trace_dir: str = ""              # jax.profiler window target
     profile_start_step: int = -1
     profile_end_step: int = -1
@@ -101,6 +108,15 @@ class TrainingArgs:
     # first pre-compiles through the warm pool (K is part of the compile
     # cache key) and cuts over only once the entry is ready.
     policy_steps: int = 0
+    # perf observatory (telemetry/perf.py): every Nth LOGGING boundary —
+    # the boundary that already carries the one metrics readback — wraps
+    # its fused dispatch in a StepProfiler window, folds the xplane op
+    # split into a PerfSnapshot, and feeds the baseline store + regression
+    # sentinel.  Windows self-limit to <perf_overhead_budget of wall and
+    # never add a device readback.  0 = off.
+    perf_window_every: int = 8
+    perf_regress_windows: int = 3            # M consecutive beyond-MAD
+    perf_overhead_budget: float = 0.01       # max profiling wall fraction
 
 
 class Trainer:
@@ -155,6 +171,26 @@ class Trainer:
             trace_dir=args.profile_trace_dir or None,
             start_step=args.profile_start_step,
             end_step=args.profile_end_step)
+
+        # perf observatory: in-train profiling windows + baseline store +
+        # regression sentinel (telemetry/perf.py).  Registered as the
+        # process singleton so flight-recorder dumps embed the latest
+        # PerfSnapshot.  The baseline lives next to the checkpoints
+        # ($ckpt_dir/perf/baseline.json) so it survives restarts with the
+        # run, keyed by the full executable identity — a strategy / K /
+        # backend / trace-env change never pollutes another key's stats.
+        self._perf = None
+        if args.perf_window_every > 0:
+            from ..telemetry.perf import PerfObservatory, set_observatory
+
+            self._perf = PerfObservatory(
+                ckpt_dir=os.path.join(args.output_dir, "checkpoints"),
+                every=args.perf_window_every,
+                m_consecutive=args.perf_regress_windows,
+                overhead_budget=args.perf_overhead_budget,
+                on_event=self._on_perf_event,
+                job_name=os.getenv("DWT_JOB_NAME", "dwt"))
+            set_observatory(self._perf)
 
         # master-tuned runtime config (batch size / ckpt cadence) — closes
         # the loop master → agent ParalConfigTuner → file → trainer.
@@ -418,6 +454,48 @@ class Trainer:
                         "(measured step %.1fms)", k, step_time_s * 1e3)
         return k
 
+    # ----------------------------------------------------- perf observatory
+
+    def _perf_key(self, fused_k: int) -> str:
+        """Executable identity for the perf baseline — the same facts that
+        key the compile cache (strategy fingerprint, fused-K, backend,
+        trace-env toggles), so baseline stats never mix executables."""
+        import jax
+
+        from ..telemetry.perf import executable_key
+
+        try:
+            fingerprint = repr((self.res.strategy.plan.describe(),
+                                self.res.strategy_spec))
+        except Exception:  # noqa: BLE001
+            fingerprint = repr(self.args.strategy)
+        return executable_key(fingerprint, int(fused_k),
+                              jax.default_backend())
+
+    def _on_perf_event(self, event: Dict) -> None:
+        """Sentinel verdicts → master node-event stream (the same surface
+        the checkpoint engine uses for ckpt-health).  Telemetry never
+        kills the run."""
+        import json as _json
+
+        if self.ctx.mc is None:
+            return
+        try:
+            self.ctx.mc.report_node_event(
+                str(event.get("kind", "perf-regression")),
+                _json.dumps(event, sort_keys=True), level="warning")
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _user_trace_active(self, s0: int, k_eff: int) -> bool:
+        """True while the opt-in StepProfiler window overlaps this fusion —
+        two jax.profiler traces can't nest, so perf windows yield."""
+        a = self.args
+        if not a.profile_trace_dir or a.profile_start_step < 0:
+            return False
+        return a.profile_start_step < s0 + k_eff and \
+            s0 <= max(a.profile_end_step, a.profile_start_step)
+
     # ---------------------------------------------------------------- train
 
     def train(self) -> Dict[str, float]:
@@ -538,6 +616,21 @@ class Trainer:
                 if a.policy_steps and self.ctx.mc is not None and \
                         s0 % a.policy_steps == 0:
                     self._poll_policy()
+                pw = None
+                if self._perf is not None and a.logging_steps and \
+                        (s0 + k_eff) % a.logging_steps == 0 and \
+                        k_eff in self._compiled_modes and \
+                        not self._user_trace_active(s0, k_eff):
+                    # perf window: only on a boundary that already carries
+                    # the logging readback (that sync flushes the fused
+                    # block's device work into the trace — zero NEW
+                    # readbacks), never on the compile dispatch (compile
+                    # wall is not a step-time baseline), and never while
+                    # the opt-in trace window is live (jax traces can't
+                    # nest).  maybe_open applies the every-Nth cadence and
+                    # the <1%-overhead self-limit.
+                    self._perf.key = self._perf_key(k_eff)
+                    pw = self._perf.maybe_open(s0, k_eff)
                 prof_before = self.profiler.last_profile
                 t_blk0 = time.monotonic()
                 with self.profiler.step(s0):
@@ -576,6 +669,19 @@ class Trainer:
                     # ONE host readback per fusion syncs the whole block
                     # (metrics["loss"] is the block's last step)
                     last_loss = float(metrics["loss"])
+                    if pw is not None:
+                        # the readback above synced the block, so the trace
+                        # holds the device work: fold the xplane op split +
+                        # step time into a PerfSnapshot, update the
+                        # baseline, run the regression sentinel, and ship
+                        # it on the buffered latest-SENT-wins verb
+                        snap = self._perf.close(pw)
+                        pw = None
+                        if snap and self.ctx.mc is not None:
+                            try:  # telemetry never kills the run
+                                self.ctx.mc.report_perf_snapshot(snap)
+                            except Exception:  # noqa: BLE001
+                                pass
                     dt = time.monotonic() - t_log
                     t_log = time.monotonic()
                     # re-read the live batch size: the master may retune it

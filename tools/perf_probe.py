@@ -7,7 +7,10 @@ the bench.py rules (host readback; chain iterations on carried values —
 
 Usage: python tools/perf_probe.py [attn|attn_sweep|head|model|opt|step|lib|
 dispatch] ...  (no args = step/attn/head/model/opt).  One JSON line per
-probe.  `dispatch` measures the fused-vs-unfused dispatch-overhead win of
+probe as it finishes, then ONE summary line ``{"probes": [...],
+"emitted": N}`` under the shared report-CLI contract
+(common/report_cli.py; -h to stderr rc=0, unknown probe rc=1).
+`dispatch` measures the fused-vs-unfused dispatch-overhead win of
 the K-step driver (trainer/train_step.py) in THIS environment.
 """
 
@@ -46,9 +49,17 @@ def _time(fn, arg, iters=20, warmup=3):
     return (time.perf_counter() - t0) / iters
 
 
+_EMITTED: list = []  # per-probe records, folded into the summary line
+
+
+def _emit_raw(obj):
+    """One historical per-probe JSON line, recorded for the summary."""
+    _EMITTED.append(obj)
+    print(json.dumps(obj), flush=True)
+
+
 def _emit(name, ms, **extra):
-    print(json.dumps({"probe": name, "ms": round(ms * 1e3, 3), **extra}),
-          flush=True)
+    _emit_raw({"probe": name, "ms": round(ms * 1e3, 3), **extra})
 
 
 def _qkv(key=0):
@@ -416,8 +427,7 @@ def probe_splash():
         t_fb = _time(fwdbwd, (q, k, v), iters=5) / INNER
         _emit("splash", t_fb, fwd_ms=round(t_f * 1e3, 3))
     except Exception as e:  # noqa: BLE001
-        print(json.dumps({"probe": "splash", "error": repr(e)[:300]}),
-              flush=True)
+        _emit_raw({"probe": "splash", "error": repr(e)[:300]})
 
 
 def probe_remat():
@@ -458,8 +468,8 @@ def probe_remat():
             _emit(f"remat_{policy}", t, temp_gb=round(temp_gb, 3))
             del res
         except Exception as e:  # noqa: BLE001
-            print(json.dumps({"probe": f"remat_{policy}",
-                              "error": repr(e)[:200]}), flush=True)
+            _emit_raw({"probe": f"remat_{policy}",
+                       "error": repr(e)[:200]})
 
 
 ALL = {"attn": probe_attn, "attn_sweep": probe_attn_sweep, "lib": probe_lib,
@@ -468,7 +478,39 @@ ALL = {"attn": probe_attn, "attn_sweep": probe_attn_sweep, "lib": probe_lib,
        "head": probe_head, "model": probe_model, "opt": probe_opt,
        "step": probe_step, "dispatch": probe_dispatch}
 
+
+def main(argv=None) -> int:
+    """Shared report-CLI contract (common/report_cli.py) around the
+    historical per-probe lines: each probe still prints its own JSON line
+    as it finishes (long sweeps stream progress), and the FINAL line is
+    the machine-parseable summary — ``{"probes": [...], "emitted": N}``
+    on success, ``{"error": ...}`` rc=1 on an unknown probe name."""
+    argv = list(argv) if argv is not None else sys.argv[1:]
+    from dlrover_wuqiong_tpu.common.report_cli import run_report
+
+    def _offline(vals):
+        names = [a for a in argv if not a.startswith("-")] \
+            or ["step", "attn", "head", "model", "opt"]
+        unknown = [n for n in names if n not in ALL]
+        if unknown:
+            raise ValueError(
+                f"unknown probe(s) {unknown}; have {sorted(ALL)}")
+        del _EMITTED[:]
+        for n in names:
+            ALL[n]()
+        return {"probes": list(_EMITTED), "emitted": len(_EMITTED)}
+
+    def _no_live(addr, vals):
+        # unreachable: _offline always returns a report
+        raise RuntimeError("perf_probe has no live-master mode")
+
+    return run_report(
+        argv, __doc__,
+        offline=_offline,
+        live=_no_live,
+        no_addr_error="perf_probe runs on-device probes, not a master "
+                      "RPC")
+
+
 if __name__ == "__main__":
-    names = sys.argv[1:] or ["step", "attn", "head", "model", "opt"]
-    for n in names:
-        ALL[n]()
+    sys.exit(main())

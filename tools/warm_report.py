@@ -4,6 +4,7 @@ Reads only the compile-cache directory's JSON sidecars (no JAX import —
 runs in milliseconds, safe from cron/CI):
 
     python tools/warm_report.py [cache_dir]
+    python tools/warm_report.py --cache-dir DIR
 
 cache_dir defaults to DWT_COMPILE_CACHE_DIR, else the framework default
 (/tmp/dwt-compile-cache-<user>).  Fields:
@@ -19,9 +20,12 @@ cache_dir defaults to DWT_COMPILE_CACHE_DIR, else the framework default
 - cache_entries / cache_dir_bytes: the XLA layer's footprint
 - inflight: warm children still compiling (stale markers expire in 10
   min — see auto/warm_pool.py)
+
+Runs under the shared report-CLI contract (common/report_cli.py): -h to
+stderr rc=0, failures are one ``{"error": ...}`` line rc=1 — this tool
+has no live-master mode, the cache dir itself is the source.
 """
 
-import json
 import os
 import sys
 
@@ -29,11 +33,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
-def main(argv=None) -> int:
-    argv = argv if argv is not None else sys.argv[1:]
+def _report(cache_dir: str) -> dict:
     from dlrover_wuqiong_tpu.auto.compile_cache import (
         cache_dir_bytes,
-        default_cache_dir,
         pool_dir,
         registry_entries,
         serve_stats,
@@ -43,7 +45,6 @@ def main(argv=None) -> int:
         warm_device_counts,
     )
 
-    cache_dir = argv[0] if argv else default_cache_dir()
     report = {
         "cache_dir": cache_dir,
         "exists": os.path.isdir(cache_dir),
@@ -77,8 +78,35 @@ def main(argv=None) -> int:
         # referenced so a refactor that drops the helper fails HERE, in
         # the tool that documents it, not silently in the master
         assert pool_dir(cache_dir)
-    print(json.dumps(report))
-    return 0
+    return report
+
+
+def main(argv=None) -> int:
+    argv = list(argv) if argv is not None else sys.argv[1:]
+    from dlrover_wuqiong_tpu.common.report_cli import run_report
+
+    def _offline(vals):
+        from dlrover_wuqiong_tpu.auto.compile_cache import (
+            default_cache_dir)
+
+        # the historical positional form (`warm_report.py DIR`) keeps
+        # working alongside the flag (tests/test_warm_pool.py drives it)
+        positional = [a for a in argv if not a.startswith("-")]
+        cache_dir = (vals.get("--cache-dir")
+                     or (positional[0] if positional
+                         else default_cache_dir()))
+        return _report(cache_dir)
+
+    def _no_live(addr, vals):
+        # unreachable: _offline always returns a report
+        raise RuntimeError("warm_report has no live-master mode")
+
+    return run_report(
+        argv, __doc__,
+        offline=_offline,
+        live=_no_live,
+        no_addr_error="warm_report reads the cache dir, not the master",
+        value_flags=("--cache-dir",))
 
 
 if __name__ == "__main__":
