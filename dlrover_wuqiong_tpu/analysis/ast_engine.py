@@ -197,6 +197,71 @@ def check_env_at_trace(path: str, tree: ast.Module,
     return findings
 
 
+# ---------------------------------------------- env-flip-outside-tuner
+
+#: the ONLY files allowed to write TRACE_ENV_VARS names into os.environ —
+#: the variant autotuner's sanctioned writer (_set_trace_env /
+#: variant_env / apply_variant own save-restore and the compile-cache
+#: re-key discipline).
+TUNER_FILES = ("auto/tuner.py",)
+
+
+def check_env_flip_outside_tuner(path: str, tree: ast.Module,
+                                 source_lines: Sequence[str],
+                                 key_vars: Set[str]) -> List[Finding]:
+    """Raw os.environ WRITES of TRACE_ENV_VARS names outside the tuner.
+
+    A DWT_FA_* value is part of the executable identity (it rides the
+    compile-cache key and the perf-observatory executable key): a raw
+    ``os.environ[...] = ...`` / ``.pop`` / ``.setdefault`` / ``del``
+    outside auto/tuner.py flips the trace env without the save-restore,
+    validation and re-key bookkeeping the sanctioned writer provides —
+    the fused cache and warm pool then disagree with the process env.
+    Route every flip through ``variant_env`` (scoped) or
+    ``apply_variant`` (cutover).  Tests are exempt (they pin behavior
+    under both values).
+    """
+    posix = path.replace(os.sep, "/")
+    parts = posix.split("/")
+    if "tests" in parts or parts[-1].startswith("test_"):
+        return []
+    if any(posix.endswith(f) for f in TUNER_FILES):
+        return []
+    if not key_vars:
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        var, how = None, ""
+        if isinstance(node, ast.Subscript) and \
+                isinstance(node.ctx, (ast.Store, ast.Del)):
+            v = _env_var_subscript(node)
+            if v in key_vars:
+                var = v
+                how = ("del os.environ[...]"
+                       if isinstance(node.ctx, ast.Del)
+                       else "os.environ[...] = ...")
+        elif isinstance(node, ast.Call):
+            func = node.func
+            if isinstance(func, ast.Attribute) and \
+                    _dotted(func.value) in ("os.environ", "environ") and \
+                    func.attr in ("pop", "setdefault"):
+                if node.args and isinstance(node.args[0], ast.Constant) \
+                        and node.args[0].value in key_vars:
+                    var = node.args[0].value
+                    how = f"os.environ.{func.attr}(...)"
+        if var and not _suppressed(source_lines, node.lineno,
+                                   "env-flip-outside-tuner"):
+            findings.append(Finding(
+                "env-flip-outside-tuner",
+                f"{how} writes trace-time toggle {var} outside the "
+                f"variant autotuner — raw flips skip save-restore and "
+                f"the compile-cache re-key; use auto/tuner.py "
+                f"variant_env (scoped) or apply_variant (cutover)",
+                path, node.lineno,
+                rule="the tuner owns TRACE_ENV_VARS writes"))
+    return findings
+
+
 # -------------------------------------------------------- donated-reuse
 
 
@@ -811,6 +876,9 @@ def run_paths(paths: Sequence[str],
         rel = os.path.relpath(path)
         if not checkers or "env-at-trace" in checkers:
             findings.extend(check_env_at_trace(rel, tree, lines, key_vars))
+        if not checkers or "env-flip-outside-tuner" in checkers:
+            findings.extend(check_env_flip_outside_tuner(
+                rel, tree, lines, key_vars))
         if not checkers or "donated-reuse" in checkers:
             findings.extend(check_donated_reuse(rel, tree, lines))
         if not checkers or "blocking-readback" in checkers:

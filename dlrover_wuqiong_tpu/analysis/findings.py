@@ -136,6 +136,13 @@ RULE_CATALOG: Dict[str, Dict[str, str]] = {
                      "jitted code bakes one process's env into shared HLO; "
                      "read toggles at module scope and close over them",
     },
+    "env-flip-outside-tuner": {
+        "engine": "ast", "severity": "error",
+        "rationale": "raw os.environ writes of TRACE_ENV_VARS names skip "
+                     "the tuner's save-restore and compile-cache re-key — "
+                     "flip variants only through auto/tuner.py "
+                     "variant_env/apply_variant",
+    },
     "donated-reuse": {
         "engine": "ast", "severity": "error",
         "rationale": "train_step/apply_sparse_update DONATE their inputs — "

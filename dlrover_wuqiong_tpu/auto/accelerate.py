@@ -38,6 +38,7 @@ from .compile_cache import (
     note_train_step_served,
     train_step_cache_key,
 )
+from .tuner import env_signature as _tuner_env_signature
 from ..parallel.sharding import ShardingPlanner
 from ..trainer.train_step import (
     TrainState,
@@ -313,33 +314,50 @@ class AccelerateResult:
     fused_steps: int = 1
     _fused_factory: Any = None   # k -> jitted fused step (None: local_sgd)
     _fused_key_fn: Any = None    # k -> framework cache key
-    _fused_cache: Dict[int, Callable] = dataclasses.field(
+    _fused_cache: Dict[tuple, Callable] = dataclasses.field(
         default_factory=dict)
     _cache_dir: Optional[str] = None
+    # trace-env values (TRACE_ENV_VARS order) the build-time `train_step`
+    # was traced under: the jit cache keys on function+signature, NOT on
+    # env, so a DWT_FA_* flip would silently reuse the old trace — the
+    # fused cache folds the CURRENT signature and rebuilds through the
+    # factory on mismatch (the CLAUDE.md "framework cache key must fold
+    # trace-time env toggles" rule, applied in-process)
+    _build_env_sig: Any = None
 
     def fused_train_step(self, fused_steps: int) -> Callable:
         """The K-step fused driver `step(state, batches)` for this build.
 
         `batches` leaves carry a leading fused axis of size K (stack K
         per-step batches with `data.elastic_dataset.stack_batches`, place
-        with `place_fused_batch`).  Built lazily and cached per K; each K
-        is a distinct compile and registers its own framework cache key
-        (K changes the HLO — auto/compile_cache.py)."""
+        with `place_fused_batch`).  Built lazily and cached per
+        (K, trace-env): each K is a distinct compile, and so is each
+        DWT_FA_* variant — the toggles are read at TRACE time, so a
+        variant cutover (auto/tuner.py) MUST retrace through the factory
+        rather than reuse a jit entry traced under the old env (K and the
+        env values both change the HLO — auto/compile_cache.py)."""
         k = int(fused_steps)
-        if k <= 1:
+        env_sig = _tuner_env_signature()
+        if k <= 1 and (self._build_env_sig is None
+                       or env_sig == self._build_env_sig):
             return self.train_step
         if self._fused_factory is None:
+            if k <= 1:
+                return self.train_step  # local_sgd: no variant rebuilds
             raise ValueError(
                 "fused_steps > 1 does not compose with local_sgd — the "
                 "DiLoCo step's outer sync counts dispatches, and a K-step "
                 "fusion would scan across sync boundaries; run unfused "
                 "(fused_steps=1)")
-        fn = self._fused_cache.get(k)
+        cache_key = (max(k, 1), env_sig)
+        fn = self._fused_cache.get(cache_key)
         if fn is None:
-            fn = self._fused_factory(k)
-            self._fused_cache[k] = fn
+            fn = self._fused_factory(max(k, 1))
+            self._fused_cache[cache_key] = fn
             if self._fused_key_fn is not None:
-                key = self._fused_key_fn(k)
+                # _key_for reads TRACE_ENV_VARS at call time: the
+                # registered framework key already carries this variant
+                key = self._fused_key_fn(max(k, 1))
                 note_train_step_served(
                     self._cache_dir, key,
                     meta={"mesh": self.strategy.plan.describe(),
@@ -753,7 +771,8 @@ def auto_accelerate(
         cache_key=cache_key, cache_warm=cache_warm,
         strategy_spec=strategy_spec,
         fused_steps=fused_steps, _fused_factory=_step_factory,
-        _fused_key_fn=_key_for, _cache_dir=cache_dir)
+        _fused_key_fn=_key_for, _cache_dir=cache_dir,
+        _build_env_sig=_tuner_env_signature())
 
 
 def _jsonable_strategy(strategy: Optional[Sequence],

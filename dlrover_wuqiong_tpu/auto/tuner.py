@@ -1,0 +1,540 @@
+"""Online kernel-variant autotuner: measured A/B over trace-time toggles.
+
+Parity: the reference tunes nothing online — `dlrover/python/master/
+hyperparams/simple_strategy_generator.py:1` picks a static strategy from
+offline heuristics and never revisits it.  On TPU the biggest single-chip
+levers left (ROADMAP item 4) are *trace-time* kernel picks — the
+`DWT_FA_*` toggles (ops/flash_attention.py:221,488,629) and the fused-K
+ladder — whose relative merit depends on shape, backend and chip load, so
+a static default leaves throughput on the table.  Chameleon (PAPERS.md)
+makes the case for measured, real-time selection; PHOENIX's zero-overhead
+principle bounds the design: tuning must never add a device sync the
+training loop wasn't already paying.
+
+Redesign, three jax-free pieces (this module imports NO jax so the
+`__graft_entry__.py` smoke and the chaos drills can exercise the scorer
+math and the persistence roundtrip without a backend):
+
+- ``variant_env`` / ``apply_variant`` — the ONE sanctioned place that
+  writes a ``TRACE_ENV_VARS`` name into ``os.environ``.  Those toggles
+  are read at TRACE time and ride every framework cache key
+  (auto/compile_cache.py:55); an ad-hoc write anywhere else poisons every
+  cache keyed on trace env (graftlint's ``env-flip-outside-tuner`` rule
+  enforces this module boundary).
+- ``InterleavedScorer`` — A/B scoring per the ±10% chip-drift rule
+  (CLAUDE.md): candidates are sampled round-robin in the SAME session and
+  compared by median-of-interleaved, never back-to-back batches.  The
+  clock is injectable so CPU tests converge deterministically.
+- ``TuningStore`` — the winner persists to ``$ckpt_dir/perf/tuning.json``
+  with the same atomic write-tmp-fsync-rename discipline as the perf
+  observatory's baseline store (telemetry/perf.py); corrupt or missing
+  files are re-learned, never fatal.  Rows are keyed by the variant
+  FAMILY (strategy fingerprint + backend — the tunables themselves stay
+  out of the key) and record the winning env, fused-K, and the winner's
+  full ``executable_key`` so reports can join against baselines.
+
+``VariantAutotuner`` drives the three online: the trainer feeds it one
+perf-observatory window per boundary (zero new readbacks — the windows
+reuse the logging-boundary loss sync), it answers with the next candidate
+to pre-warm + cut over to (every candidate is a distinct compile-cache
+key, so cutover through the warm pool is zero-cold-compile), and on
+convergence it persists the winner and surfaces the decision as
+PolicyDecision-style history with measured before/after.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import os
+import tempfile
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+from ..common.log import get_logger
+from .compile_cache import TRACE_ENV_VARS
+
+logger = get_logger("tuner")
+
+# persisted under the checkpoint dir, next to the baseline store
+TUNING_SUBDIR = "perf"
+TUNING_FILE = "tuning.json"
+
+# record schema version (ADD-ONLY: extend, never rename)
+_SCHEMA = 1
+
+
+# ------------------------------------------------------------------ env
+
+def env_signature() -> Tuple[str, ...]:
+    """Current values of the trace-time toggles, in TRACE_ENV_VARS order.
+
+    This tuple IS the variant identity of the running process: it rides
+    the in-process fused-step cache key (auto/accelerate.py) and the
+    trainer's compiled-modes set, mirroring how `executable_key`
+    (telemetry/perf.py) and `train_step_cache_key` fold the same values.
+    """
+    return tuple(os.environ.get(k, "") for k in TRACE_ENV_VARS)
+
+
+def _set_trace_env(env: Dict[str, str]) -> Dict[str, Optional[str]]:
+    """Write trace-env toggles; returns the previous values for restore.
+
+    The ONLY sanctioned writer of TRACE_ENV_VARS names (graftlint
+    `env-flip-outside-tuner`).  An empty-string value unsets the toggle —
+    the kernels treat unset and "" differently for DWT_FA_STREAMED
+    (ops/flash_attention.py:631), so "" must genuinely delete.
+    """
+    prev: Dict[str, Optional[str]] = {}
+    for name, value in env.items():
+        if name not in TRACE_ENV_VARS:
+            raise ValueError(
+                f"{name} is not a trace-time toggle (TRACE_ENV_VARS) — "
+                f"the tuner only owns {TRACE_ENV_VARS}")
+        prev[name] = os.environ.get(name)
+        if value == "" or value is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = str(value)
+    return prev
+
+
+@contextlib.contextmanager
+def variant_env(env: Dict[str, str]) -> Iterator[None]:
+    """Scoped trace-env flip: compile/measure a candidate, then restore.
+
+    Every A/B site in the repo (probes, chaos drills, the autotuner
+    itself) routes through here so the flip is paired with its restore
+    and visibly sanctioned.  Tracing/compiling a candidate MUST happen
+    inside the `with` block — the toggles are read at trace time.
+    """
+    prev = _set_trace_env(env)
+    try:
+        yield
+    finally:
+        for name, old in prev.items():
+            if old is None:
+                os.environ.pop(name, None)
+            else:
+                os.environ[name] = old
+
+
+def apply_variant(env: Dict[str, str]) -> None:
+    """Process-lifetime variant application (no restore).
+
+    Used at cutover (the trainer adopts the winner) and by warm-pool
+    children applying a spec's `trace_env` before the first trace.
+    """
+    _set_trace_env(env)
+
+
+# ------------------------------------------------------------- variants
+
+
+@dataclass(frozen=True)
+class Variant:
+    """One tunable configuration: a trace-env dict plus optional fused-K.
+
+    `env` covers only TRACE_ENV_VARS names; a missing name means "leave
+    as-is", an empty string means "unset".  `fused_steps=0` means "keep
+    the current K" (sentinel, mirrors PolicyDecision's no-change zeros).
+    """
+
+    name: str
+    env: Dict[str, str] = field(default_factory=dict)
+    fused_steps: int = 0
+
+    def signature(self) -> Tuple[str, ...]:
+        """TRACE_ENV_VARS-ordered values this variant pins (others "")."""
+        return tuple(self.env.get(k, "") for k in TRACE_ENV_VARS)
+
+
+def default_variants(backend: str = "cpu",
+                     include_k: Tuple[int, ...] = ()) -> List[Variant]:
+    """The stock candidate matrix over the DWT_FA_* space.
+
+    Kept deliberately small — each candidate costs one warm-pool compile
+    and `windows_per_variant` measurement windows.  The pack-width sweep
+    only pays on TPU (the CPU fallback never reaches the Pallas kernels),
+    so CPU defaults stay at the fused/unfused/streamed axes.
+    """
+    variants = [
+        Variant("default", {}),
+        Variant("no-fused", {"DWT_FA_NO_FUSED": "1"}),
+        Variant("streamed", {"DWT_FA_STREAMED": "1"}),
+    ]
+    if backend == "tpu":
+        variants += [
+            Variant("pack4", {"DWT_FA_PACK": "4"}),
+            Variant("unstreamed", {"DWT_FA_STREAMED": "0"}),
+        ]
+    for k in include_k:
+        variants.append(Variant(f"fused-k{k}", {}, fused_steps=int(k)))
+    return variants
+
+
+# --------------------------------------------------------------- scorer
+
+
+class InterleavedScorer:
+    """Median-of-interleaved A/B scoring with hysteresis.
+
+    Chip-load drift on the shared tunnel is ±10% run to run (CLAUDE.md),
+    so candidates must be sampled round-robin in the same session; the
+    median of interleaved samples cancels slow drift that would bury a
+    back-to-back comparison.  `winner()` applies a hysteresis margin: a
+    challenger must beat the incumbent's median by more than
+    `hysteresis` (relative) or the incumbent is kept — statistically
+    tied variants never flap.
+    """
+
+    def __init__(self, candidates: List[str], *,
+                 min_samples: int = 3,
+                 hysteresis: float = 0.05,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        if not candidates:
+            raise ValueError("scorer needs at least one candidate")
+        if len(set(candidates)) != len(candidates):
+            raise ValueError(f"duplicate candidate names: {candidates}")
+        self.candidates = list(candidates)
+        self.min_samples = max(1, int(min_samples))
+        self.hysteresis = float(hysteresis)
+        self.clock = clock
+        self.samples: Dict[str, List[float]] = {c: [] for c in candidates}
+
+    def next_candidate(self) -> str:
+        """Least-sampled candidate, ties broken by declaration order —
+        i.e. strict round-robin interleave."""
+        return min(self.candidates, key=lambda c: len(self.samples[c]))
+
+    def note(self, name: str, value: float) -> None:
+        if name not in self.samples:
+            raise KeyError(f"unknown candidate {name!r}")
+        self.samples[name].append(float(value))
+
+    def measure(self, name: str, fn: Callable[[], Any]) -> float:
+        """Time one invocation with the injectable clock and record it."""
+        t0 = self.clock()
+        fn()
+        dt = self.clock() - t0
+        self.note(name, dt)
+        return dt
+
+    def medians(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for name, vals in self.samples.items():
+            if vals:
+                s = sorted(vals)
+                n = len(s)
+                out[name] = (s[n // 2] if n % 2
+                             else 0.5 * (s[n // 2 - 1] + s[n // 2]))
+        return out
+
+    def complete(self) -> bool:
+        """Every candidate has at least `min_samples` samples."""
+        return all(len(v) >= self.min_samples
+                   for v in self.samples.values())
+
+    def winner(self, incumbent: Optional[str] = None) -> Tuple[str, bool]:
+        """(winner_name, decided).  Lower median wins; the incumbent is
+        kept unless a challenger clears the hysteresis margin."""
+        if not self.complete():
+            fallback = incumbent if incumbent in self.samples \
+                else self.candidates[0]
+            return fallback, False
+        med = self.medians()
+        best = min(med, key=lambda c: (med[c], self.candidates.index(c)))
+        if incumbent in med and best != incumbent:
+            if med[best] >= med[incumbent] * (1.0 - self.hysteresis):
+                return incumbent, True
+        return best, True
+
+
+# ---------------------------------------------------------------- store
+
+
+def tuning_path(ckpt_dir: str) -> str:
+    return os.path.join(ckpt_dir, TUNING_SUBDIR, TUNING_FILE)
+
+
+def family_key(strategy_fingerprint: str, backend: str) -> str:
+    """Stable digest of the NON-tunable executable identity.
+
+    Same ingredients as `executable_key` (telemetry/perf.py:108) minus
+    the tunables (fused-K and the trace env) — all variants of one
+    training program share a family, so the persisted winner can be
+    looked up before the first trace of a later run.
+    """
+    payload = json.dumps({"strategy": strategy_fingerprint,
+                          "backend": backend}, sort_keys=True)
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+class TuningStore:
+    """Atomic, corrupt-tolerant winner persistence (tuning.json).
+
+    Mirrors the baseline store's discipline (telemetry/perf.py
+    BaselineStore): load tolerates a missing/corrupt/truncated file by
+    starting empty (the tuner re-learns — never fatal), publish writes
+    tmp + fsync + os.replace so a SIGKILL mid-write leaves the previous
+    winner intact.
+    """
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._rows: Dict[str, Dict[str, Any]] = self._load()
+
+    def _load(self) -> Dict[str, Dict[str, Any]]:
+        try:
+            with open(self.path) as f:
+                raw = json.load(f)
+            if not isinstance(raw, dict):
+                raise ValueError("payload is not a dict")
+            rows = raw.get("families", {})
+            if not isinstance(rows, dict):
+                raise ValueError("families is not a dict")
+            return {str(k): dict(v) for k, v in rows.items()
+                    if isinstance(v, dict)}
+        except FileNotFoundError:
+            return {}
+        except (OSError, ValueError, TypeError) as e:
+            logger.warning("tuning store %s unreadable (%s) — re-learning",
+                           self.path, e)
+            return {}
+
+    def lookup(self, family: str) -> Optional[Dict[str, Any]]:
+        row = self._rows.get(family)
+        return dict(row) if row else None
+
+    def rows(self) -> Dict[str, Dict[str, Any]]:
+        return {k: dict(v) for k, v in self._rows.items()}
+
+    def publish(self, family: str, record: Dict[str, Any]) -> None:
+        self._rows[family] = dict(record)
+        payload = {"schema": _SCHEMA, "families": self._rows}
+        d = os.path.dirname(self.path) or "."
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(payload, f, sort_keys=True)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+
+def make_record(variant: Variant, *, executable_key: str,
+                fused_steps: int, medians: Dict[str, float],
+                windows: int) -> Dict[str, Any]:
+    """The persisted winner row (ADD-ONLY keys)."""
+    return {
+        "variant": variant.name,
+        "env": dict(variant.env),
+        "fused_steps": int(fused_steps),
+        "executable_key": executable_key,
+        "medians": {k: float(v) for k, v in medians.items()},
+        "windows": int(windows),
+        # persisted cross-process timestamp — wall clock is correct here
+        "ts": time.time(),
+    }
+
+
+def load_winner(ckpt_dir: str, family: str) -> Optional[Dict[str, Any]]:
+    """Startup shortcut: the persisted winner for this family, if any.
+
+    bench.py and the trainer call this before the first trace so later
+    runs start on the tuned variant instead of re-searching; the caller
+    applies `record["env"]` through `apply_variant` (sanctioned) and
+    `record["fused_steps"]` through the normal pre-warm path.
+    """
+    if not ckpt_dir:
+        return None
+    return TuningStore(tuning_path(ckpt_dir)).lookup(family)
+
+
+# ------------------------------------------------------------ autotuner
+
+
+class VariantAutotuner:
+    """Online tuning state machine the trainer drives at fusion boundaries.
+
+    Protocol (all calls from the trainer's host loop — no device work):
+
+    - ``current()`` — the variant whose windows are being measured now.
+    - ``note_window(step_time_s)`` — one perf-observatory window closed
+      for the current variant; returns the NEXT variant to pre-warm and
+      cut over to (or None while staying put).  The scorer interleaves,
+      so the next variant usually differs from the current one.
+    - ``finished`` / ``result()`` — once every candidate has its windows,
+      the winner is decided (hysteresis: ties keep the incumbent),
+      persisted through the store, and recorded as a PolicyDecision-style
+      entry in ``decisions`` with measured before/after medians.
+
+    The tuner never touches jax and never flips env itself mid-run — the
+    TRAINER owns applying `Variant.env` (through `apply_variant`) only
+    after the warm pool reports the candidate ready, so a cutover never
+    pays a cold compile (CLAUDE.md: K and DWT_FA_* changes pre-warm).
+    Thread-safety: all state behind one lock; the metrics pump thread
+    calls ``note_window`` while the main loop reads ``current()``.
+    """
+
+    def __init__(self, variants: List[Variant], *,
+                 store: Optional[TuningStore] = None,
+                 family: str = "",
+                 windows_per_variant: int = 3,
+                 hysteresis: float = 0.05,
+                 incumbent: str = "default",
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        if not variants:
+            raise ValueError("autotuner needs at least one variant")
+        self.variants = {v.name: v for v in variants}
+        if len(self.variants) != len(variants):
+            raise ValueError("duplicate variant names")
+        self.store = store
+        self.family = family
+        self.incumbent = incumbent if incumbent in self.variants \
+            else variants[0].name
+        self.scorer = InterleavedScorer(
+            [v.name for v in variants],
+            min_samples=windows_per_variant,
+            hysteresis=hysteresis, clock=clock)
+        self.clock = clock
+        self.decisions: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+        self._current = self.incumbent
+        self._finished = False
+        self._winner: Optional[str] = None
+
+    # -- read side -------------------------------------------------
+
+    def current(self) -> Variant:
+        with self._lock:
+            return self.variants[self._current]
+
+    @property
+    def finished(self) -> bool:
+        with self._lock:
+            return self._finished
+
+    def result(self) -> Optional[Variant]:
+        with self._lock:
+            return self.variants[self._winner] if self._winner else None
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Lossy telemetry view (medians + progress) for reports."""
+        with self._lock:
+            return {
+                "current": self._current,
+                "finished": self._finished,
+                "winner": self._winner or "",
+                "windows": {c: len(s)
+                            for c, s in self.scorer.samples.items()},
+                "medians": self.scorer.medians(),
+            }
+
+    # -- write side ------------------------------------------------
+
+    def note_window(self, step_time_s: float) -> Optional[Variant]:
+        """Credit one measured window to the current variant; answer with
+        the next variant to pre-warm/cut to, or None when settled."""
+        with self._lock:
+            if self._finished:
+                return None
+            self.scorer.note(self._current, step_time_s)
+            if self.scorer.complete():
+                name, _ = self.scorer.winner(incumbent=self.incumbent)
+                self._winner = name
+                self._finished = True
+                nxt = None if name == self._current \
+                    else self.variants[name]
+                # converge: current() must answer the winner so the
+                # trainer's boundary poll settles on it
+                self._current = name
+                winner_var = self.variants[name]
+                medians = self.scorer.medians()
+                windows = sum(len(s)
+                              for s in self.scorer.samples.values())
+            else:
+                nxt_name = self.scorer.next_candidate()
+                if nxt_name == self._current:
+                    return None
+                self._current = nxt_name
+                return self.variants[nxt_name]
+        # winner path: persist + record OUTSIDE the lock (publish fsyncs)
+        self._record_decision(winner_var, medians, windows)
+        return nxt
+
+    def cutover(self, variant: Variant) -> None:
+        """The trainer confirms it switched execution to `variant`."""
+        with self._lock:
+            if variant.name in self.variants:
+                self._current = variant.name
+
+    def _record_decision(self, winner: Variant,
+                         medians: Dict[str, float],
+                         windows: int) -> None:
+        before = medians.get(self.incumbent, 0.0)
+        after = medians.get(winner.name, 0.0)
+        decision = {
+            "decision_id": f"tune-{self.family or 'local'}-{windows}",
+            "kind": "tuner",
+            "variant": winner.name,
+            "env": dict(winner.env),
+            "fused_steps": winner.fused_steps,
+            "before": {"step_time_s": before},
+            "after": {"step_time_s": after},
+            "windows": windows,
+        }
+        with self._lock:
+            self.decisions.append(decision)
+        logger.info("tuner decided: %s (median %.4fs -> %.4fs over %d "
+                    "windows)", winner.name, before, after, windows)
+        if self.store is not None and self.family:
+            try:
+                from .compile_cache import TRACE_ENV_VARS as _vars
+                exe_env = {k: winner.env.get(k, "") for k in _vars}
+                record = make_record(
+                    winner,
+                    executable_key=self._winner_executable_key(winner),
+                    fused_steps=winner.fused_steps,
+                    medians=medians, windows=windows)
+                record["exe_env"] = exe_env
+                self.store.publish(self.family, record)
+            except OSError as e:  # persistence is best-effort
+                logger.warning("tuning winner not persisted: %s", e)
+
+    def _winner_executable_key(self, winner: Variant) -> str:
+        """The winner's FULL executable identity, joinable against the
+        baseline store.  Computed under the winner's env (scoped flip —
+        executable_key reads os.environ at call time)."""
+        try:
+            from ..telemetry.perf import executable_key as _ek
+        except Exception:  # noqa: BLE001 — telemetry optional in smokes
+            return ""
+        ctx = self._exe_key_ctx or {}
+        with variant_env(dict(winner.env)):
+            return _ek(ctx.get("strategy_fingerprint", self.family),
+                       int(winner.fused_steps
+                           or ctx.get("fused_steps", 1) or 1),
+                       ctx.get("backend", "cpu"))
+
+    _exe_key_ctx: Optional[Dict[str, Any]] = None
+
+    def bind_executable_context(self, *, strategy_fingerprint: str,
+                                fused_steps: int, backend: str) -> None:
+        """Trainer provides the identity ingredients once at startup so
+        the persisted record carries a real executable_key."""
+        self._exe_key_ctx = {
+            "strategy_fingerprint": strategy_fingerprint,
+            "fused_steps": int(fused_steps),
+            "backend": backend,
+        }

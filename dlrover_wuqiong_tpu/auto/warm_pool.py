@@ -86,6 +86,14 @@ class WarmSpec:
     # quant are all in the serving compile-cache key, so a replacement
     # decode worker after `chaos serve-drain` finds its programs warm).
     serve: Optional[Dict] = None
+    # ADD-ONLY: trace-time env toggles (TRACE_ENV_VARS names only) the
+    # child applies — through the tuner's sanctioned setter — before its
+    # first trace.  The toggles change the emitted HLO, so a variant
+    # candidate (auto/tuner.py) is a DIFFERENT compile from the default:
+    # carrying them in the spec makes spec_key/dedup variant-aware and
+    # lets the autotuner pre-warm every candidate before cutover.  None
+    # means "inherit the parent's env" (the pre-tuner behavior).
+    trace_env: Optional[Dict] = None
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), sort_keys=True)
@@ -282,6 +290,15 @@ class WarmPool:
         for var in TRACE_ENV_VARS:
             if os.getenv(var):
                 env[var] = os.environ[var]
+        if getattr(spec, "trace_env", None) is not None:
+            # spec-pinned variant: the spec's view wins over inheritance
+            # (an empty-string value means "unset" — tuner semantics)
+            for var in TRACE_ENV_VARS:
+                val = spec.trace_env.get(var, "")
+                if val:
+                    env[var] = str(val)
+                else:
+                    env.pop(var, None)
         pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
         pythonpath = env.get("PYTHONPATH", "")
@@ -436,6 +453,14 @@ def _child_main(spec_path: str) -> int:
     """
     with open(spec_path) as f:
         spec = WarmSpec.from_json(f.read())
+    if getattr(spec, "trace_env", None):
+        # variant candidate: apply the spec's trace toggles through the
+        # tuner's sanctioned setter BEFORE the backend/first trace — the
+        # toggles are read at trace time and pick kernel paths
+        from .tuner import apply_variant
+
+        apply_variant({k: str(v) for k, v in spec.trace_env.items()
+                       if k in TRACE_ENV_VARS})
     if spec.platform == "cpu":
         os.environ["JAX_PLATFORMS"] = "cpu"
         flags = os.environ.get("XLA_FLAGS", "")
@@ -548,6 +573,7 @@ def _child_main(spec_path: str) -> int:
             "fused_steps": fused,
             "compile_s": round(time.monotonic() - t0, 2),
             "already_cached": (h1 - h0) > 0 and (m1 - m0) == 0,
+            "trace_env": dict(getattr(spec, "trace_env", None) or {}),
             "ready": True,
             "ts": time.time(),
         }

@@ -341,3 +341,38 @@ class PolicyEngine:
         "before" side; the "after" fills on the next observe_perf."""
         self._perf_before = self._last_perf
         self._perf_after = None
+
+
+# ------------------------------------------------------------ tuner bridge
+
+
+def tuner_decision_effects(decisions: List[Dict]) -> List[Dict]:
+    """PolicyDecision-style history rows for variant-autotuner cutovers.
+
+    The autotuner (auto/tuner.py) measures its own before/after — the
+    interleaved perf-window medians of the incumbent and the winner — so
+    unlike a master-side decision its effect needs no ``observe_perf``
+    round trip: each row embeds an ``effect`` shaped exactly like
+    ``PolicyEngine.decision_effect()`` output ({decision_id, before,
+    after}) and lands in the trainer's ``policy_applied`` log next to the
+    master's rows, so post-mortem tooling reads one history (rows with
+    ``kind == "tuner"`` are local decisions, journal-free by design: the
+    winner is durable in tuning.json, not in the master journal).
+    """
+    out: List[Dict] = []
+    for d in decisions:
+        did = str(d.get("decision_id", ""))
+        out.append({
+            "decision_id": did,
+            "kind": "tuner",
+            "variant": str(d.get("variant", "")),
+            "env": dict(d.get("env") or {}),
+            "fused_steps": int(d.get("fused_steps") or 0),
+            "windows": int(d.get("windows") or 0),
+            "effect": {
+                "decision_id": did,
+                "before": dict(d.get("before") or {}),
+                "after": dict(d.get("after") or {}),
+            },
+        })
+    return out

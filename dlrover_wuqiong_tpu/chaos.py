@@ -2296,10 +2296,14 @@ def perf_regress() -> Dict:
        sits in the collective category fires `perf-regression` after
        EXACTLY M consecutive beyond-bound windows, once per excursion,
        and attributes the moved category.
-    3. KEY ISOLATION: flipping a TRACE_ENV_VARS toggle changes the
-       executable key (a different executable is a new baseline, never a
-       false regression), and the published store survives an atomic
-       write + reload round-trip with identical stats.
+    3. KEY ISOLATION: flipping a TRACE_ENV_VARS toggle (through the
+       tuner's sanctioned `variant_env`) changes the executable key
+       (a different executable is a new baseline, never a false
+       regression), and the published store survives an atomic write +
+       reload round-trip with identical stats.
+    4. TUNER CUTOVER: after a variant cutover the sentinel judges the
+       new key against its OWN fresh baseline — step times that fired
+       under the old key never fire post-cutover.
     """
     import random
     import shutil
@@ -2338,17 +2342,32 @@ def perf_regress() -> Dict:
             e = window(0.16, 0.56)
             if e is not None:
                 fired.append((i + 1, e))
-        # 3) key isolation across a trace-env flip + store round-trip
-        env_var = "DWT_FA_NO_FUSED"
-        saved = os.environ.get(env_var)
-        try:
-            os.environ[env_var] = "1"
+        # 3) key isolation across a trace-env flip + store round-trip —
+        #    flipped through the tuner's sanctioned scoped writer
+        #    (auto/tuner.py; graftlint env-flip-outside-tuner forbids
+        #    raw os.environ writes of TRACE_ENV_VARS names)
+        from .auto.tuner import variant_env
+
+        with variant_env({"DWT_FA_NO_FUSED": "1"}):
             flipped = executable_key("drill-fingerprint", 8, "cpu")
-        finally:
-            if saved is None:
-                os.environ.pop(env_var, None)
-            else:
-                os.environ[env_var] = saved
+        # 4) tuner cutover: the flipped variant is a NEW executable key,
+        #    so its windows land on a FRESH baseline — step times that
+        #    would be deep beyond-bound under the OLD key (the throttled
+        #    phase already fired on them) must never fire the sentinel
+        #    after a cutover
+        cutover_events = []
+        n_cut = 0
+        for i in range(4 * m_consec):
+            beyond, event = sentinel.observe(
+                flipped, 0.16, {"matmul": 0.112, "collective": 0.048},
+                step=n_cut)
+            n_cut += 8
+            if event is not None:
+                cutover_events.append(event)
+            if not beyond:
+                store.update(flipped, 0.16,
+                             {"matmul": 0.112, "collective": 0.048})
+                store.publish()
         reloaded = BaselineStore(
             path=os.path.join(work, "perf", "baseline.json"))
         report.update(
@@ -2358,6 +2377,10 @@ def perf_regress() -> Dict:
             fired_kind=fired[0][1]["kind"] if fired else "",
             attributed_category=fired[0][1]["category"] if fired else "",
             key_changed_on_env_flip=flipped != key,
+            cutover_windows=4 * m_consec,
+            cutover_fired=len(cutover_events),
+            cutover_baseline_n=int((store.stats(flipped) or
+                                    {}).get("n", 0)),
             baseline_roundtrip=reloaded.stats(key) == store.stats(key)
             and store.stats(key) is not None,
         )
@@ -2368,6 +2391,8 @@ def perf_regress() -> Dict:
             and fired[0][1]["kind"] == "perf-regression"
             and fired[0][1]["category"] == "collective"
             and report["key_changed_on_env_flip"]
+            and not cutover_events
+            and report["cutover_baseline_n"] > 0
             and report["baseline_roundtrip"])
         return report
     finally:

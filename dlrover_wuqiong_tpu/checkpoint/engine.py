@@ -94,6 +94,21 @@ class CheckpointEngine:
         self._latest_step = -1
         self._drain_thread: Optional[threading.Thread] = None
         self._drain_error: Optional[BaseException] = None
+        # staging overlap (ISSUE 15): a save no longer waits out the PRIOR
+        # drain on the training thread — the new drain thread joins its
+        # predecessor first (the predecessor Thread object is passed as an
+        # ARG, so the ordering is plain happens-before, no shared flag).
+        # `_drain_lock` guards the cross-thread mutables below; the chain
+        # is BOUNDED at depth 2 (one running + one queued): each queued
+        # drain holds a full device snapshot, so deeper chains would
+        # accumulate HBM copies until OOM — at the bound the save falls
+        # back to the old blocking wait.
+        self._drain_lock = threading.Lock()
+        self._drain_pending = 0
+        # seconds the drain chain spent waiting on predecessors, credited
+        # to the ledger by the MAIN thread at the next save boundary
+        # (ledger credits land at fusion boundaries, CLAUDE.md)
+        self._chain_wait_s = 0.0
         self._snapshot_fn = None  # jitted tree-copy, cached across saves
         if standalone is None:
             # a worker launched by an elastic agent must attach to the agent's
@@ -204,32 +219,62 @@ class CheckpointEngine:
                 raise TimeoutError(
                     f"checkpoint staging of step {self._latest_step} still "
                     f"in flight after {timeout}s")
-        if self._drain_error is not None:
+        with self._drain_lock:
             err, self._drain_error = self._drain_error, None
+        if err is not None:
             raise err
 
-    def _drain(self, snapshot: Any, step: int, extra: Dict,
-               storage_path: Optional[str]):
-        """Background: snapshot → shm (batched async D2H), then hand off."""
+    def _take_drain_error(self) -> Optional[BaseException]:
+        with self._drain_lock:
+            err, self._drain_error = self._drain_error, None
+        return err
+
+    def _drain(self, prev: Optional[threading.Thread], snapshot: Any,
+               step: int, extra: Dict, storage_path: Optional[str]):
+        """Background: wait out the predecessor staging (the segment must
+        stay whole — one writer at a time), then snapshot → shm (batched
+        async D2H), then hand off."""
         try:
+            if prev is not None and prev.is_alive():
+                t0 = time.monotonic()
+                prev.join()
+                waited = time.monotonic() - t0
+                with self._drain_lock:
+                    self._chain_wait_s += waited
             self._stage_locked(snapshot, step, extra)
             if storage_path is not None:
                 self._event_queue.put(CheckpointEvent.save(step,
                                                            storage_path))
         except BaseException as e:  # noqa: BLE001 — surfaced on next save
             logger.exception("checkpoint drain of step %d failed", step)
-            self._drain_error = e
+            with self._drain_lock:
+                self._drain_error = e
+        finally:
+            with self._drain_lock:
+                self._drain_pending -= 1
 
     def _start_save(self, step: int, state: Any, extra_meta: Optional[Dict],
                     path: Optional[str],
                     storage_path: Optional[str]) -> float:
         with tspans.span("ckpt:save", {"step": step}):
             t0 = time.monotonic()
-            self._wait_drain()  # one staging at a time keeps the segment whole
-            # ledger split: waiting out the PRIOR async staging is persist
-            # stall; everything after is this save's own stage cost
+            # staging overlap: a prior drain still in flight no longer
+            # blocks here — the new drain thread chains behind it.  Only
+            # at the chain bound (one running + one queued snapshot in
+            # HBM) does this save pay the old blocking wait.
+            with self._drain_lock:
+                pending = self._drain_pending
+                chain_wait, self._chain_wait_s = self._chain_wait_s, 0.0
+            if pending >= 2:
+                self._wait_drain()  # bound the snapshot chain (HBM)
+            err = self._take_drain_error()
+            if err is not None:
+                raise err
+            # ledger split: time spent waiting out PRIOR stagings (here
+            # or accumulated inside the drain chain) is persist stall;
+            # everything after is this save's own stage cost
             t_persist = time.monotonic() - t0
-            get_ledger().account("ckpt_persist", t_persist)
+            get_ledger().account("ckpt_persist", t_persist + chain_wait)
             extra = dict(extra_meta or {})
             # tag the segment with its checkpoint dir so a later process can't
             # restore a stale segment left over from an unrelated job run
@@ -247,6 +292,9 @@ class CheckpointEngine:
                     raise
                 logger.warning("device snapshot does not fit HBM; staging "
                                "synchronously (%s)", type(e).__name__)
+                # the sync path writes the segment from THIS thread: any
+                # chained drain must land first (one writer at a time)
+                self._wait_drain()
                 self._stage_locked(state, step, extra)
                 self._latest_step = step
                 if storage_path is not None:
@@ -257,8 +305,11 @@ class CheckpointEngine:
                                      max(0.0, blocked - t_persist))
                 return blocked
             self._latest_step = step
+            prev = self._drain_thread
+            with self._drain_lock:
+                self._drain_pending += 1
             self._drain_thread = threading.Thread(
-                target=self._drain, args=(snapshot, step, extra,
+                target=self._drain, args=(prev, snapshot, step, extra,
                                           storage_path),
                 daemon=True, name="dwt-ckpt-drain")
             self._drain_thread.start()

@@ -51,6 +51,7 @@ import json
 import os
 import shutil
 import tempfile
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -68,7 +69,7 @@ PERF_SNAPSHOT_KEYS = (
     "baseline_median_s", "baseline_mad_s", "baseline_n", "categories",
     "overhead_s", "overhead_frac", "windows", "skipped",
     "cache_hits", "cache_misses", "retraces", "regressions",
-    "last_event", "captured_at",
+    "last_event", "captured_at", "tuned_variant",
 )
 
 # ADD-ONLY: the perf-regression / retrace event envelope (node-event
@@ -289,7 +290,8 @@ class _Window:
     dispatch). Created by PerfObservatory.maybe_open, closed by .close."""
 
     def __init__(self, prof, ctx, span_ctx, step: int, fused_k: int,
-                 tdir: str, open_cost_s: float, t_run0: float):
+                 tdir: str, open_cost_s: float, t_run0: float,
+                 key: str = ""):
         self.prof = prof
         self.ctx = ctx
         self.span_ctx = span_ctx
@@ -298,6 +300,12 @@ class _Window:
         self.tdir = tdir
         self.open_cost_s = open_cost_s
         self.t_run0 = t_run0
+        # executable key CAPTURED at open time: `close` may run on the
+        # trainer's metrics-pump thread while the main loop re-keys the
+        # observatory for a variant cutover (auto/tuner.py) — the window
+        # must fold into the baseline row of the executable it measured,
+        # not whichever key is current when the pump drains it
+        self.key = key
 
 
 class PerfObservatory:
@@ -330,6 +338,15 @@ class PerfObservatory:
         self._job = job_name
         self._reg = registry
         self._t_start = time.monotonic()
+        # counters shared between the trainer's main loop (maybe_open)
+        # and its metrics-pump thread (close): one lock guards them all.
+        # Blocking work — the baseline publish's fsync, the profiler
+        # trace teardown — stays OUTSIDE the lock (graftlint
+        # blocking-under-lock); store/sentinel internals need no lock of
+        # their own because `close` runs on exactly one thread at a time
+        # (the pump is a single consumer; without a pump it is the main
+        # loop itself).
+        self._lock = threading.Lock()
         self._overhead_s = 0.0
         self._eligible = 0
         self._windows = 0
@@ -339,6 +356,16 @@ class PerfObservatory:
         self._last_event: Optional[Dict] = None
         self._cache_seen: Optional[Tuple[int, int]] = None
         self._snapshot: Optional[Dict] = None
+        # active autotuner variant name ("" = untuned/default run) —
+        # written by the trainer at cutover, read by the pump's close()
+        self._tuned_variant = ""
+
+    def set_tuned_variant(self, name: str) -> None:
+        """Label snapshots with the variant-autotuner's active choice
+        (auto/tuner.py) so PerfQuery/flight consumers can attribute a
+        step-time shift to a cutover instead of a regression."""
+        with self._lock:
+            self._tuned_variant = str(name)
 
     # ----------------------------------------------------------- helpers
     def _registry(self):
@@ -350,20 +377,27 @@ class PerfObservatory:
 
     def overhead_fraction(self) -> float:
         wall = max(time.monotonic() - self._t_start, 1e-9)
-        return self._overhead_s / wall
+        with self._lock:
+            overhead = self._overhead_s
+        return overhead / wall
 
     def snapshot(self) -> Optional[Dict]:
-        return self._snapshot
+        with self._lock:
+            return self._snapshot
 
     # ----------------------------------------------------------- windows
     def maybe_open(self, step: int, fused_k: int) -> Optional[_Window]:
         """Open a window on every ``every``-th eligible boundary, unless
         the self-limiter says profiling already costs ≥ budget of wall."""
-        self._eligible += 1
-        if (self._eligible - 1) % self.every:
+        with self._lock:
+            self._eligible += 1
+            eligible = self._eligible
+            windows = self._windows
+        if (eligible - 1) % self.every:
             return None
-        if self._windows and self.overhead_fraction() >= self.overhead_budget:
-            self._skipped += 1
+        if windows and self.overhead_fraction() >= self.overhead_budget:
+            with self._lock:
+                self._skipped += 1
             return None
         from ..utils.profiler import StepProfiler
 
@@ -386,7 +420,7 @@ class PerfObservatory:
             return None
         return _Window(prof, ctx, span_ctx, step, fused_k, tdir,
                        open_cost_s=time.monotonic() - t0,
-                       t_run0=time.monotonic())
+                       t_run0=time.monotonic(), key=self.key)
 
     def close(self, win: _Window) -> Optional[Dict]:
         """Fold the window into a PerfSnapshot; returns the snapshot.
@@ -404,26 +438,29 @@ class PerfObservatory:
         win.span_ctx.__exit__(None, None, None)
         overhead = win.open_cost_s + (time.monotonic() - t1)
         shutil.rmtree(win.tdir, ignore_errors=True)
-        self._overhead_s += overhead
-        self._windows += 1
+        with self._lock:
+            self._overhead_s += overhead
+            self._windows += 1
         self._credit_overhead(overhead)
 
+        key = win.key or self.key
         step_s = t_run / win.fused_k
         prof = win.prof.last_profile
         cats = ({k: float(v) for k, v in prof.categories.items()}
                 if prof is not None else {})
-        beyond, event = self.sentinel.observe(self.key, step_s, cats,
+        beyond, event = self.sentinel.observe(key, step_s, cats,
                                               step=win.step)
         if not beyond:
             # beyond-bound windows stay OUT of the baseline: a sustained
             # regression must not median its way into normal
-            self.store.update(self.key, step_s, cats)
+            self.store.update(key, step_s, cats)
             self.store.publish()
         if event is not None:
-            self._regressions += 1
+            with self._lock:
+                self._regressions += 1
             self._fire(event)
         self._observe_compile_counters(win.step)
-        return self._fold_snapshot(win, step_s, cats)
+        return self._fold_snapshot(win, key, step_s, cats)
 
     def _credit_overhead(self, seconds: float) -> None:
         try:
@@ -434,7 +471,8 @@ class PerfObservatory:
             pass
 
     def _fire(self, event: Dict) -> None:
-        self._last_event = event
+        with self._lock:
+            self._last_event = event
         counter = {"perf-regression": "dwt_perf_regression_events",
                    "retrace": "dwt_perf_retrace_events"}.get(event["kind"])
         if counter:
@@ -465,12 +503,14 @@ class PerfObservatory:
         except Exception:  # noqa: BLE001
             return
         now = counters.snapshot()
-        prev, self._cache_seen = self._cache_seen, now
+        with self._lock:
+            prev, self._cache_seen = self._cache_seen, now
         if prev is None:
             return  # first window: compiles before it are expected
         miss_delta = now[1] - prev[1]
         if miss_delta > 0:
-            self._retraces += miss_delta
+            with self._lock:
+                self._retraces += miss_delta
             self._fire({
                 "kind": "retrace", "key": self.key, "step": step,
                 "step_time_s": 0.0, "baseline_median_s": 0.0,
@@ -479,35 +519,40 @@ class PerfObservatory:
                 "category_delta_s": 0.0,
             })
 
-    def _fold_snapshot(self, win: _Window, step_s: float,
+    def _fold_snapshot(self, win: _Window, key: str, step_s: float,
                        cats: Dict[str, float]) -> Dict:
-        stats = self.store.stats(self.key) or {"median": 0.0, "mad": 0.0,
-                                               "n": 0}
-        hits, misses = self._cache_seen or (0, 0)
-        snap = {
-            "schema": PERF_SCHEMA,
-            "key": self.key,
-            "step": win.step,
-            "fused_k": win.fused_k,
-            "step_time_s": step_s,
-            "baseline_median_s": stats["median"],
-            "baseline_mad_s": stats["mad"],
-            "baseline_n": int(stats["n"]),
-            "categories": {k: round(v, 6) for k, v in sorted(cats.items())},
-            "overhead_s": round(self._overhead_s, 6),
-            "overhead_frac": round(self.overhead_fraction(), 6),
-            "windows": self._windows,
-            "skipped": self._skipped,
-            "cache_hits": int(hits),
-            "cache_misses": int(misses),
-            "retraces": self._retraces,
-            "regressions": self._regressions,
-            "last_event": self._last_event,
-            # wall stamp: persisted into flight dumps and compared across
-            # processes by the latest-SENT-wins verb (never duration math)
-            "captured_at": time.time(),
-        }
-        self._snapshot = snap
+        stats = self.store.stats(key) or {"median": 0.0, "mad": 0.0,
+                                          "n": 0}
+        overhead_frac = self.overhead_fraction()
+        with self._lock:
+            hits, misses = self._cache_seen or (0, 0)
+            snap = {
+                "schema": PERF_SCHEMA,
+                "key": key,
+                "step": win.step,
+                "fused_k": win.fused_k,
+                "step_time_s": step_s,
+                "baseline_median_s": stats["median"],
+                "baseline_mad_s": stats["mad"],
+                "baseline_n": int(stats["n"]),
+                "categories": {k: round(v, 6)
+                               for k, v in sorted(cats.items())},
+                "overhead_s": round(self._overhead_s, 6),
+                "overhead_frac": round(overhead_frac, 6),
+                "windows": self._windows,
+                "skipped": self._skipped,
+                "cache_hits": int(hits),
+                "cache_misses": int(misses),
+                "retraces": self._retraces,
+                "regressions": self._regressions,
+                "last_event": self._last_event,
+                # wall stamp: persisted into flight dumps and compared
+                # across processes by the latest-SENT-wins verb (never
+                # duration math)
+                "captured_at": time.time(),
+                "tuned_variant": self._tuned_variant,
+            }
+            self._snapshot = snap
         return snap
 
 

@@ -79,6 +79,11 @@ class TrainingArgs:
                 f"{self.perf_window_every} (>= 0), perf_regress_windows="
                 f"{self.perf_regress_windows} (>= 1), perf_overhead_budget="
                 f"{self.perf_overhead_budget} (in (0, 1])")
+        if self.tune_variants < 0 or not 0.0 <= self.tune_hysteresis < 1.0:
+            raise ValueError(
+                f"bad autotuner knobs: tune_variants={self.tune_variants} "
+                f"(>= 0; 0 = off), tune_hysteresis={self.tune_hysteresis} "
+                f"(in [0, 1))")
     profile_trace_dir: str = ""              # jax.profiler window target
     profile_start_step: int = -1
     profile_end_step: int = -1
@@ -117,6 +122,130 @@ class TrainingArgs:
     perf_window_every: int = 8
     perf_regress_windows: int = 3            # M consecutive beyond-MAD
     perf_overhead_budget: float = 0.01       # max profiling wall fraction
+    # online variant autotuner (auto/tuner.py): N > 0 A/B-measures the
+    # DWT_FA_* variant space with N perf-observatory windows per
+    # candidate, interleaved (chip-load drift is ±10% run to run —
+    # CLAUDE.md), each candidate pre-compiled through the warm pool
+    # before its first measured window, winner persisted to
+    # $ckpt_dir/perf/tuning.json so later runs start tuned.  0 = off.
+    # Requires the perf observatory (perf_window_every > 0).
+    tune_variants: int = 0
+    tune_hysteresis: float = 0.05            # challenger must win by this
+    # overlap the logging boundary's host work (metrics readback, perf
+    # window close, master reports) with the next fused dispatch via the
+    # metrics pump thread; False = inline (sync).  User callbacks force
+    # the inline path regardless: they are the loop's synchronous
+    # surface (request_stop, config pushes) and must observe the
+    # boundary before the next fusion dispatches.
+    async_metrics: bool = True
+
+
+class _MetricsPump:
+    """Single background consumer for the logging boundary's host work.
+
+    Overlap: the per-fusion metrics readback (`float(loss)`), the perf
+    window close (xplane parse + baseline publish fsync), the master
+    reports and the user callbacks move off the hot loop onto ONE daemon
+    thread draining a bounded queue — the next fused dispatch overlaps
+    the host work instead of serializing behind it.  Invariants:
+
+    - ledger CREDITS stay on the main thread at fusion boundaries
+      (CLAUDE.md telemetry rules): a job ships the snapshot dict taken
+      at its boundary, never the live ledger;
+    - `metrics` is an executable OUTPUT — donation-immune (CLAUDE.md),
+      so reading it back after the next dispatch has donated the inputs
+      is safe;
+    - at most `maxsize` boundaries ride in flight (put() backpressures
+      the main loop instead of queueing unbounded device values), and at
+      most ONE open perf window (the trainer gates `maybe_open` on
+      `windows_inflight() == 0` — jax traces can't nest);
+    - a consume error leaves `windows_inflight` elevated on purpose: a
+      half-closed window may still hold the profiler trace, and a stuck
+      gate (no further windows) is safe where a nested trace is not;
+    - the RpcClient serializes frames under its own lock, so master
+      verbs from this thread never interleave with the main loop's;
+    - joined from train()'s finally (conftest thread-leak guard).
+
+    `enabled=False` (async_metrics off) consumes inline on the caller's
+    thread — same code path, synchronous semantics.
+    """
+
+    def __init__(self, trainer: "Trainer", enabled: bool = True,
+                 maxsize: int = 2):
+        import queue
+        import threading
+
+        self._trainer = trainer
+        self._lock = threading.Lock()
+        self._last_loss = float("nan")
+        self._windows_inflight = 0
+        self._drained = 0
+        self._errors = 0
+        self._q: Any = None
+        self._thread: Any = None
+        if enabled:
+            self._q = queue.Queue(maxsize=maxsize)
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="dwt-metrics-pump")
+            self._thread.start()
+
+    def submit(self, job: Dict[str, Any]) -> None:
+        if job.get("pw") is not None:
+            with self._lock:
+                self._windows_inflight += 1
+        if self._thread is None:
+            # inline path: exceptions propagate — a raising user callback
+            # must abort training exactly as the pre-pump loop did
+            self._note_done(job, self._trainer._consume_boundary(job))
+        else:
+            self._q.put(job)
+
+    def windows_inflight(self) -> int:
+        with self._lock:
+            return self._windows_inflight
+
+    def last_loss(self) -> float:
+        with self._lock:
+            return self._last_loss
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"drained": self._drained, "errors": self._errors}
+
+    def stop(self, timeout: float = 60.0) -> None:
+        """Flush queued boundaries and join (train()'s finally)."""
+        if self._thread is None:
+            return
+        self._q.put(None)
+        self._thread.join(timeout)
+        self._thread = None
+
+    def _run(self) -> None:
+        while True:
+            job = self._q.get()
+            if job is None:
+                return
+            self._consume(job)
+
+    def _consume(self, job: Dict[str, Any]) -> None:
+        # async path only: the pump can't propagate across threads, so a
+        # failed boundary is logged and counted, never fatal
+        try:
+            loss = self._trainer._consume_boundary(job)
+        except Exception:  # noqa: BLE001 — see docstring
+            logger.exception("metrics pump: boundary %s failed",
+                             job.get("step"))
+            with self._lock:
+                self._errors += 1
+            return
+        self._note_done(job, loss)
+
+    def _note_done(self, job: Dict[str, Any], loss: float) -> None:
+        with self._lock:
+            self._last_loss = loss
+            self._drained += 1
+            if job.get("pw") is not None:
+                self._windows_inflight -= 1
 
 
 class Trainer:
@@ -212,6 +341,16 @@ class Trainer:
         self._policy_pending_k: Optional[int] = None
         self._warm_pool = None
         self.policy_applied: list = []
+
+        # online variant autotuner (auto/tuner.py): search only when no
+        # winner is persisted for this executable FAMILY — later runs
+        # start tuned.  Needs the perf observatory (windows are the
+        # scorer's only signal).
+        self._tuner = None
+        self._tuner_reported = False
+        self._variant_active = "default"
+        if args.tune_variants > 0 and self._perf is not None:
+            self._init_tuner()
 
         # device-queue liveness probe → master hang localization
         self._prober = None
@@ -352,6 +491,137 @@ class Trainer:
                     "deferred until the entry is ready", k)
         return False
 
+    # ------------------------------------------------- variant autotuner
+
+    def _init_tuner(self) -> None:
+        """Start tuned when a winner is persisted for this executable
+        family (strategy + backend, excluding the tunables); otherwise
+        build the interleaved search over the default variant space.
+        Corrupt/missing tuning.json falls through to re-learn (the store
+        tolerates it) — never fatal."""
+        import jax
+
+        from ..auto import tuner as vt
+
+        a = self.args
+        backend = jax.default_backend()
+        family = vt.family_key(self._strategy_fingerprint(), backend)
+        store = vt.TuningStore(
+            vt.tuning_path(os.path.join(a.output_dir, "checkpoints")))
+        winner = store.lookup(family)
+        if winner is not None:
+            # apply before the first dispatch: the fused cache re-keys on
+            # the env signature, so this retraces exactly once and the
+            # compile credit below keeps it out of the baselines
+            env = winner.get("exe_env") or winner.get("env") or {}
+            vt.apply_variant({str(k): str(v) for k, v in env.items()})
+            self._variant_active = str(winner.get("variant") or "default")
+            if self._perf is not None:
+                self._perf.set_tuned_variant(self._variant_active)
+            k_win = int(winner.get("fused_steps") or 0)
+            cad = self._hook_cadence()
+            if k_win > 1 and a.fused_steps == 0 and \
+                    (not cad or cad % k_win == 0):
+                a.fused_steps = k_win  # skip the K re-measurement too
+            logger.info("tuner: starting on persisted winner %r "
+                        "(family %s)", self._variant_active, family)
+            return
+        self._tuner = vt.VariantAutotuner(
+            vt.default_variants(backend), store=store, family=family,
+            windows_per_variant=a.tune_variants,
+            hysteresis=a.tune_hysteresis)
+        self._tuner.bind_executable_context(
+            strategy_fingerprint=self._strategy_fingerprint(),
+            fused_steps=max(a.fused_steps, 1), backend=backend)
+
+    def _variant_full_env(self, variant) -> Dict[str, str]:
+        """Full TRACE_ENV_VARS assignment for a variant — vars the
+        variant leaves alone map to "" so `apply_variant` DELETES them
+        (unset is a distinct value: DWT_FA_STREAMED unset means the
+        sequence-length heuristic, not off)."""
+        from ..auto.compile_cache import TRACE_ENV_VARS
+
+        return {k: str(variant.env.get(k, "")) for k in TRACE_ENV_VARS}
+
+    def _maybe_apply_variant(self, fused_k) -> None:
+        """Fusion-boundary variant cutover, following the tuner's
+        interleave schedule.  The next candidate pre-warms through the
+        warm pool (its env rides WarmSpec.trace_env — every variant is a
+        distinct compile-cache key), and the env flip happens only when
+        the entry is ready, so no measured window ever pays a cold
+        compile.  When the search settles, the decision surfaces as
+        PolicyDecision-style history (policy_applied + a node event)
+        with the measured before/after medians."""
+        tuner = self._tuner
+        if tuner is None:
+            return
+        if tuner.finished and not self._tuner_reported:
+            self._tuner_reported = True
+            from ..brain.policy import tuner_decision_effects
+
+            effects = tuner_decision_effects(tuner.decisions)
+            self.policy_applied.extend(effects)
+            if effects and self.ctx.mc is not None:
+                import json as _json
+
+                try:  # telemetry never kills the run
+                    self.ctx.mc.report_node_event(
+                        "tuner-decision",
+                        _json.dumps(effects[-1], sort_keys=True),
+                        level="info")
+                except Exception:  # noqa: BLE001
+                    pass
+        desired = tuner.current()
+        if desired.name == self._variant_active:
+            return
+        if not self._prewarm_variant(desired, fused_k):
+            return  # entry still compiling: stay put, poll next boundary
+        from ..auto.tuner import apply_variant
+
+        apply_variant(self._variant_full_env(desired))
+        self._variant_active = desired.name
+        if self._perf is not None:
+            self._perf.set_tuned_variant(desired.name)
+        tuner.cutover(desired)
+        if desired.fused_steps and fused_k is not None and \
+                desired.fused_steps != (fused_k or 1):
+            # K rides the existing policy cutover path (stager rebuild,
+            # cadence clamp) — same boundary discipline as a DWT_FA_* flip
+            self._policy_pending_k = int(desired.fused_steps)
+
+    def _prewarm_variant(self, variant, fused_k) -> bool:
+        """True when the variant's executable is already live here (its
+        (K, env) mode was dispatched before) or the warm pool holds a
+        ready entry.  No cache dir / no published spec → allow: the
+        compile-credit path still keeps the first dispatch out of the
+        perf windows via _compiled_modes."""
+        from ..auto.tuner import env_signature, variant_env
+
+        k = int(variant.fused_steps or (fused_k or 1))
+        with variant_env(self._variant_full_env(variant)):
+            mode = (k, env_signature())
+        if mode in self._compiled_modes:
+            return True
+        cache_dir = os.getenv("DWT_COMPILE_CACHE_DIR", "")
+        if not cache_dir:
+            return True
+        from ..auto.warm_pool import WarmPool, load_current_spec
+
+        if self._warm_pool is None:
+            self._warm_pool = WarmPool(cache_dir)
+        spec = load_current_spec(cache_dir)
+        if spec is None:
+            return True
+        spec = dataclasses.replace(
+            spec, fused_steps=k,
+            trace_env=self._variant_full_env(variant))
+        if self._warm_pool._ready_entry_for(spec.spec_key()) is not None:
+            return True
+        self._warm_pool.warm_async(spec)
+        logger.info("tuner: warming variant %r in the pool — cutover "
+                    "deferred until the entry is ready", variant.name)
+        return False
+
     # ------------------------------------------------------------- schedule
 
     def _make_schedule(self, optax):
@@ -456,20 +726,26 @@ class Trainer:
 
     # ----------------------------------------------------- perf observatory
 
+    def _strategy_fingerprint(self) -> str:
+        """Strategy identity shared by the perf baseline key and the
+        tuner's family key — excludes the tunables (env, K)."""
+        try:
+            return repr((self.res.strategy.plan.describe(),
+                         self.res.strategy_spec))
+        except Exception:  # noqa: BLE001
+            return repr(self.args.strategy)
+
     def _perf_key(self, fused_k: int) -> str:
         """Executable identity for the perf baseline — the same facts that
         key the compile cache (strategy fingerprint, fused-K, backend,
-        trace-env toggles), so baseline stats never mix executables."""
+        trace-env toggles), so baseline stats never mix executables and a
+        tuner cutover lands on a NEW key instead of firing the regression
+        sentinel against the old variant's baseline."""
         import jax
 
         from ..telemetry.perf import executable_key
 
-        try:
-            fingerprint = repr((self.res.strategy.plan.describe(),
-                                self.res.strategy_spec))
-        except Exception:  # noqa: BLE001
-            fingerprint = repr(self.args.strategy)
-        return executable_key(fingerprint, int(fused_k),
+        return executable_key(self._strategy_fingerprint(), int(fused_k),
                               jax.default_backend())
 
     def _on_perf_event(self, event: Dict) -> None:
@@ -496,6 +772,50 @@ class Trainer:
         return a.profile_start_step < s0 + k_eff and \
             s0 <= max(a.profile_end_step, a.profile_start_step)
 
+    # ------------------------------------------------- boundary consumer
+
+    def _consume_boundary(self, job: Dict[str, Any]) -> float:
+        """One logging boundary's host work — runs on the metrics pump
+        thread (inline when async_metrics=False).  The ONE readback per
+        fusion lives here; that sync also flushes the fused block's
+        device work into any open perf window's trace.  Reads trainer
+        state but never writes it — results flow back through the pump's
+        lock-guarded fields."""
+        step = job["step"]
+        # metrics is an executable OUTPUT: donation-immune, safe to read
+        # after the main thread has dispatched the next fusion
+        loss = float(job["metrics"]["loss"])
+        snap = None
+        pw = job.get("pw")
+        if pw is not None:
+            # the readback above synced the block, so the trace holds the
+            # device work: fold the xplane op split + step time into a
+            # PerfSnapshot, update the baseline, run the regression
+            # sentinel, and ship it on the buffered latest-SENT-wins verb
+            snap = self._perf.close(pw)
+        tps = job["steps"] * job["tokens_per_step"] / \
+            max(job["dt_s"], 1e-9)
+        logger.info("step %d loss=%.4f tokens/s=%.0f", step, loss, tps)
+        self.ctx.report_step(step)
+        self.ctx.report_loss(step, loss)
+        if self.ctx.mc is not None:
+            try:  # buffered verbs; telemetry never kills the run
+                if snap:
+                    self.ctx.mc.report_perf_snapshot(snap)
+                self.ctx.mc.report_goodput_ledger(job["ledger"])
+            except Exception:  # noqa: BLE001
+                pass
+        if snap and self._tuner is not None and \
+                job.get("tune_variant") == self._variant_active:
+            # credit the window to the variant that actually executed it
+            # (note_window is lock-guarded); the returned next candidate
+            # is picked up by the main loop's boundary poll
+            self._tuner.note_window(
+                float(snap.get("step_time_s") or 0.0))
+        for cb in self.callbacks:
+            cb(step, {"loss": loss, "tokens_per_sec": tps})
+        return loss
+
     # ---------------------------------------------------------------- train
 
     def train(self) -> Dict[str, float]:
@@ -503,6 +823,7 @@ class Trainer:
 
         import jax
 
+        from ..auto.tuner import env_signature
         from ..telemetry.ledger import get_ledger
         from ..telemetry.recorder import get_recorder
 
@@ -567,8 +888,14 @@ class Trainer:
         # ckpt_stage/persist + restore tiers; master_client credits
         # degraded.  All accounting happens HERE at fusion boundaries from
         # host-side timers — never inside the jitted step, never via an
-        # extra device readback.
+        # extra device readback.  Modes are (K, trace-env signature): a
+        # variant cutover's first dispatch is a compile, not overhead.
         self._compiled_modes: set = set()
+        # callbacks are synchronous user hooks (request_stop, config
+        # pushes assert their effect on the NEXT fusion) — their presence
+        # forces the inline path
+        self._pump = _MetricsPump(
+            self, enabled=a.async_metrics and not self.callbacks)
         try:
             while step < a.max_steps and not self._preempted:
                 t_iter0 = time.monotonic()
@@ -591,6 +918,11 @@ class Trainer:
                         fused_k = self._policy_pending_k
                         self._policy_pending_k = None
                         stager = None
+                if self._tuner is not None and fused_k is not None:
+                    # variant cutover at the boundary, warm-pool gated —
+                    # only after the K auto-tune settles (the unfused
+                    # measurement steps must not race an env flip)
+                    self._maybe_apply_variant(fused_k)
                 self._fused_k_active = fused_k or 0
                 if fused_k is not None and fused_k > 1 and stager is None:
                     from ..data.elastic_dataset import FusedBatchStager
@@ -617,18 +949,27 @@ class Trainer:
                         s0 % a.policy_steps == 0:
                     self._poll_policy()
                 pw = None
+                env_mode = (k_eff, env_signature())
                 if self._perf is not None and a.logging_steps and \
                         (s0 + k_eff) % a.logging_steps == 0 and \
-                        k_eff in self._compiled_modes and \
+                        env_mode in self._compiled_modes and \
+                        self._pump.windows_inflight() == 0 and \
+                        (self._tuner is None or
+                         self._tuner.current().name ==
+                         self._variant_active) and \
                         not self._user_trace_active(s0, k_eff):
                     # perf window: only on a boundary that already carries
                     # the logging readback (that sync flushes the fused
                     # block's device work into the trace — zero NEW
                     # readbacks), never on the compile dispatch (compile
-                    # wall is not a step-time baseline), and never while
-                    # the opt-in trace window is live (jax traces can't
-                    # nest).  maybe_open applies the every-Nth cadence and
-                    # the <1%-overhead self-limit.
+                    # wall is not a step-time baseline), never while the
+                    # opt-in trace window is live or a pump-held window is
+                    # still closing (jax traces can't nest), and — when
+                    # tuning — only while execution matches the tuner's
+                    # current candidate, so a deferred cutover never
+                    # credits the old variant's windows to the new one.
+                    # maybe_open applies the every-Nth cadence and the
+                    # <1%-overhead self-limit.
                     self._perf.key = self._perf_key(k_eff)
                     pw = self._perf.maybe_open(s0, k_eff)
                 prof_before = self.profiler.last_profile
@@ -639,7 +980,11 @@ class Trainer:
                             k_eff)(self.state, batch)
                     else:
                         t0 = time.perf_counter()
-                        self.state, metrics = self.res.train_step(
+                        # width-1 through the variant-aware fused cache:
+                        # identical to train_step until a DWT_FA_* cutover
+                        # changes the env signature, which must retrace
+                        # instead of reusing the old trace
+                        self.state, metrics = self.res.fused_train_step(1)(
                             self.state, batch)
                         if fused_k is None:
                             # auto-tune measurement: sync so the timing is
@@ -647,9 +992,10 @@ class Trainer:
                             float(metrics["loss"])
                             step_time_s = time.perf_counter() - t0
                 blk_s = time.monotonic() - t_blk0
-                if k_eff not in self._compiled_modes:
-                    # first dispatch at this fusion width traces+compiles
-                    self._compiled_modes.add(k_eff)
+                if env_mode not in self._compiled_modes:
+                    # first dispatch at this (fusion width, variant env)
+                    # traces+compiles
+                    self._compiled_modes.add(env_mode)
                     led.account("compile", blk_s)
                     credited_blk = blk_s
                 else:
@@ -666,41 +1012,27 @@ class Trainer:
                 # ---- boundary hooks: K divides every active cadence, so
                 # these fire exactly as in the unfused loop ----
                 if a.logging_steps and step % a.logging_steps == 0:
-                    # ONE host readback per fusion syncs the whole block
-                    # (metrics["loss"] is the block's last step)
-                    last_loss = float(metrics["loss"])
-                    if pw is not None:
-                        # the readback above synced the block, so the trace
-                        # holds the device work: fold the xplane op split +
-                        # step time into a PerfSnapshot, update the
-                        # baseline, run the regression sentinel, and ship
-                        # it on the buffered latest-SENT-wins verb
-                        snap = self._perf.close(pw)
-                        pw = None
-                        if snap and self.ctx.mc is not None:
-                            try:  # telemetry never kills the run
-                                self.ctx.mc.report_perf_snapshot(snap)
-                            except Exception:  # noqa: BLE001
-                                pass
+                    # the boundary's host work — the ONE readback per
+                    # fusion, the perf-window close, the master reports
+                    # and the callbacks — goes to the metrics pump so the
+                    # next fused dispatch overlaps it instead of
+                    # serializing behind the sync.  Ledger CREDITS stayed
+                    # above on this thread; the pump only ships the
+                    # snapshot dict taken here at the boundary.
                     dt = time.monotonic() - t_log
                     t_log = time.monotonic()
                     # re-read the live batch size: the master may retune it
                     tokens_per_step = a.seq_len * getattr(
                         self.train_data, "batch_size", a.global_batch_size)
-                    tps = steps_since_log * tokens_per_step / max(dt, 1e-9)
+                    self._pump.submit({
+                        "step": step, "metrics": metrics, "pw": pw,
+                        "dt_s": dt, "steps": steps_since_log,
+                        "tokens_per_step": tokens_per_step,
+                        "ledger": led.snapshot(),
+                        "tune_variant": self._variant_active,
+                    })
+                    pw = None
                     steps_since_log = 0
-                    logger.info("step %d loss=%.4f tokens/s=%.0f", step,
-                                last_loss, tps)
-                    self.ctx.report_step(step)
-                    self.ctx.report_loss(step, last_loss)
-                    if self.ctx.mc is not None:
-                        try:  # buffered verb; telemetry never kills the run
-                            self.ctx.mc.report_goodput_ledger(led.snapshot())
-                        except Exception:  # noqa: BLE001
-                            pass
-                    for cb in self.callbacks:
-                        cb(step, {"loss": last_loss,
-                                  "tokens_per_sec": tps})
                 saved = False
                 if a.save_steps and step % a.save_steps == 0:
                     t_h = time.monotonic()
@@ -742,6 +1074,13 @@ class Trainer:
             get_recorder().flush(self.ckpt.checkpoint_dir, "fault")
             raise
         finally:
+            # flush queued boundaries + join (thread-leak guard) BEFORE
+            # the final cumulative ledger ship, so latest-wins ordering
+            # holds at the master
+            self._pump.stop()
+            pump_loss = self._pump.last_loss()
+            if pump_loss == pump_loss:
+                last_loss = pump_loss
             if self._preempted:
                 get_recorder().flush(self.ckpt.checkpoint_dir, "sigterm")
             if self.ctx.mc is not None:

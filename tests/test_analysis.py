@@ -326,6 +326,76 @@ class TestEnvAtTrace:
                 "DWT_FA_STREAMED"} <= vars_
 
 
+class TestEnvFlipOutsideTuner:
+    """env-flip-outside-tuner: raw os.environ writes of TRACE_ENV_VARS
+    names belong to auto/tuner.py (variant_env / apply_variant)."""
+
+    def test_raw_writes_flagged(self, tmp_path):
+        found = _scan_source(
+            tmp_path, "pkg/runtime/flip.py", """\
+            '''Parity: ref.py:1'''
+            import os
+
+            def go():
+                os.environ["DWT_FA_STREAMED"] = "1"
+                os.environ.pop("DWT_FA_NO_FUSED", None)
+                os.environ.setdefault("DWT_FA_PACK", "4")
+                del os.environ["DWT_FA_STREAMED"]
+            """,
+            checkers=["env-flip-outside-tuner"],
+            key_vars={"DWT_FA_STREAMED", "DWT_FA_NO_FUSED",
+                      "DWT_FA_PACK"})
+        assert [f.checker for f in found] == \
+            ["env-flip-outside-tuner"] * 4
+        assert sorted(f.line for f in found) == [5, 6, 7, 8]
+        assert "variant_env" in found[0].message
+
+    def test_tuner_file_and_tests_exempt(self, tmp_path):
+        src = """\
+            '''Parity: ref.py:1'''
+            import os
+
+            def _set(name, value):
+                os.environ["DWT_FA_STREAMED"] = value
+            """
+        for rel in ("pkg/auto/tuner.py", "pkg/tests/test_flip.py",
+                    "pkg/test_flip.py"):
+            found = _scan_source(
+                tmp_path / rel.replace("/", "_"), rel, src,
+                checkers=["env-flip-outside-tuner"],
+                key_vars={"DWT_FA_STREAMED"})
+            assert found == [], rel
+
+    def test_non_key_vars_and_reads_clean(self, tmp_path):
+        found = _scan_source(
+            tmp_path, "pkg/runtime/flip.py", """\
+            '''Parity: ref.py:1'''
+            import os
+
+            def go():
+                os.environ["DWT_JOB_NAME"] = "j"       # not a trace var
+                v = os.environ.get("DWT_FA_STREAMED")  # read, not write
+                return v
+            """,
+            checkers=["env-flip-outside-tuner"],
+            key_vars={"DWT_FA_STREAMED"})
+        assert found == []
+
+    def test_suppression_honored(self, tmp_path):
+        found = _scan_source(
+            tmp_path, "pkg/runtime/flip.py", """\
+            '''Parity: ref.py:1'''
+            import os
+
+            def go():
+                os.environ["DWT_FA_PACK"] = "4"  \
+# graftlint: disable=env-flip-outside-tuner -- fixture exercises raw flip
+            """,
+            checkers=["env-flip-outside-tuner"],
+            key_vars={"DWT_FA_PACK"})
+        assert found == []
+
+
 class TestWallClockDuration:
     """wall-clock-duration (warning): time.time() in duration math."""
 

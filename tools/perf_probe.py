@@ -6,12 +6,14 @@ the bench.py rules (host readback; chain iterations on carried values —
 `block_until_ready` is a no-op over the tunnel).
 
 Usage: python tools/perf_probe.py [attn|attn_sweep|head|model|opt|step|lib|
-dispatch] ...  (no args = step/attn/head/model/opt).  One JSON line per
-probe as it finishes, then ONE summary line ``{"probes": [...],
+dispatch|fa-variants] ...  (no args = step/attn/head/model/opt).  One JSON
+line per probe as it finishes, then ONE summary line ``{"probes": [...],
 "emitted": N}`` under the shared report-CLI contract
 (common/report_cli.py; -h to stderr rc=0, unknown probe rc=1).
 `dispatch` measures the fused-vs-unfused dispatch-overhead win of
-the K-step driver (trainer/train_step.py) in THIS environment.
+the K-step driver (trainer/train_step.py) in THIS environment;
+`fa-variants` A/B-measures the DWT_FA_* kernel-variant matrix
+interleaved (same-session, chip drift) via the tuner's scorer.
 """
 
 from __future__ import annotations
@@ -387,6 +389,78 @@ def probe_dispatch(k: int = 8, steps: int = 32):
           auto_k=auto_fused_steps(t_fused, overhead_s=step_overhead))
 
 
+def probe_fa_variants(rounds: int = 3):
+    """Interleaved A/B over the DWT_FA_* kernel-variant matrix (ISSUE 15).
+
+    The flash-attention fwd+bwd microbench, compiled ONCE per variant
+    under its scoped env flip (auto/tuner.py `variant_env` — the toggles
+    are read at TRACE time, so each variant needs its own jit trace,
+    compiled before any timing), then measured in interleaved rounds:
+    chip-load drift on the shared tunnel is ±10% run to run, so
+    same-session interleave is the only honest comparison (CLAUDE.md).
+    Inner repeats chain inside one jit call so the ~5-8ms per-dispatch
+    tunnel tax is amortized out of sub-20ms samples.  Scoring reuses the
+    tuner's `InterleavedScorer` (median per candidate, hysteresis keeps
+    the incumbent on a tie) — the probe and the online tuner agree by
+    construction.  On CPU the toggles lower to the reference path and
+    near-equal medians are the expected negative result."""
+    from dlrover_wuqiong_tpu.auto import tuner as vt
+    from dlrover_wuqiong_tpu.ops.flash_attention import flash_attention
+
+    if jax.default_backend() == "tpu":
+        q, k, v = _qkv()
+    else:  # runnable anywhere: nano shape keeps the CPU reference fast
+        ks = jax.random.split(jax.random.PRNGKey(5), 3)
+        q, k, v = (jax.random.normal(key, (2, 2, 128, 64), jnp.bfloat16)
+                   for key in ks)
+
+    def _make_fwdbwd():
+        # a FRESH jitted function object per variant: jit caches on
+        # function identity + signature, never on env, so sharing one
+        # would silently reuse the first variant's trace
+        @jax.jit
+        def fwdbwd(args):
+            q, k, v = args
+
+            def loss(q, k, v):
+                return flash_attention(q, k, v, causal=True).astype(
+                    jnp.float32).sum()
+
+            for _ in range(INNER):
+                dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+                q, k, v = (dq.astype(q.dtype), dk.astype(k.dtype),
+                           dv.astype(v.dtype))
+            return (q, k, v)
+
+        return fwdbwd
+
+    cands = [var for var in vt.default_variants(jax.default_backend())
+             if not var.fused_steps]  # fused-K is the trainer's axis
+    compiled = {}
+    for var in cands:
+        env = {name: str(var.env.get(name, ""))
+               for name in vt.TRACE_ENV_VARS}
+        fn = _make_fwdbwd()
+        with vt.variant_env(env):  # scoped flip: trace under THIS env
+            arg = fn((q, k, v))
+        _sync(arg)
+        compiled[var.name] = fn
+
+    scorer = vt.InterleavedScorer([var.name for var in cands],
+                                  min_samples=rounds)
+    while not scorer.complete():
+        name = scorer.next_candidate()
+        # already traced: measurement needs no env (read at trace time)
+        t = _time(compiled[name], (q, k, v), iters=2, warmup=1) / INNER
+        scorer.note(name, t)
+    meds = scorer.medians()
+    winner, decided = scorer.winner(incumbent="default")
+    _emit_raw({"probe": "fa_variants", "winner": winner,
+               "decided": decided, "rounds": rounds, "interleaved": True,
+               "medians_ms": {n: round(t * 1e3, 3)
+                              for n, t in sorted(meds.items())}})
+
+
 def probe_splash():
     """jax splash-attention (newer vmapped MQA-style kernel) — causal."""
     try:
@@ -476,7 +550,8 @@ ALL = {"attn": probe_attn, "attn_sweep": probe_attn_sweep, "lib": probe_lib,
        "remat": probe_remat,
        "splash": probe_splash, "dots": probe_dots,
        "head": probe_head, "model": probe_model, "opt": probe_opt,
-       "step": probe_step, "dispatch": probe_dispatch}
+       "step": probe_step, "dispatch": probe_dispatch,
+       "fa-variants": probe_fa_variants}
 
 
 def main(argv=None) -> int:
