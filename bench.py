@@ -422,10 +422,12 @@ def _main():
                      ("serve_tokens_per_s", "serve_p50_ms",
                       "serve_p99_ms", "serve_vs_sequential")})
     if tune_report:
-        # add-only autotuner keys: the settled variant and how many
-        # measured windows the decision took
+        # add-only autotuner keys: the settled variant, the geometry
+        # class its winner persisted under, and how many measured
+        # windows the decision took
         line.update({k: tune_report[k] for k in
-                     ("tuned_variant", "tune_windows")})
+                     ("tuned_variant", "tuned_shape_class",
+                      "tune_windows")})
     if trace_report.get("device_op_categories"):
         # add-only: the device-op category split of the headline step
         # (DWT_BENCH_TRACE_DIR window) rides the same line so the
@@ -555,21 +557,28 @@ def _tuner_run(res, cfg, batch, seq, state, inner: int = 8):
     backend = jax.default_backend()
     family_src = repr(getattr(res, "strategy_spec", None))
     tick = iter(range(1_000_000_000))
+
+    # dispatch-bound nano regime off-TPU (same reasoning as
+    # _fused_vs_perstep): the smaller the step, the more a variant's
+    # overhead difference matters relative to noise.  Shrink BEFORE
+    # computing the shape class — the per-geometry winner must be keyed
+    # by the geometry actually measured
+    if backend != "tpu":
+        batch, seq = 1, min(32, seq)
+    width = getattr(cfg, "n_embd", None) or getattr(cfg, "hidden_size", 0)
+    depth = getattr(cfg, "n_layer", None) or getattr(cfg, "num_layers", 0)
+    sc = vt.shape_class(batch, seq,
+                        f"d{width}x{depth}" if width and depth else "")
     tuner = vt.VariantAutotuner(
         vt.default_variants(backend),
         store=vt.TuningStore(vt.tuning_path(
             f"/tmp/dwt-bench-ckpt-{os.getpid()}")),
         family=vt.family_key(family_src, backend),
         windows_per_variant=2 if backend == "tpu" else 3,
+        shape_class=sc,
         clock=lambda: float(next(tick)))
     tuner.bind_executable_context(strategy_fingerprint=family_src,
                                   fused_steps=1, backend=backend)
-
-    # dispatch-bound nano regime off-TPU (same reasoning as
-    # _fused_vs_perstep): the smaller the step, the more a variant's
-    # overhead difference matters relative to noise
-    if backend != "tpu":
-        batch, seq = 1, min(32, seq)
     rng = np.random.default_rng(23)
     x = rng.integers(0, cfg.vocab_size, (batch, seq + 1), dtype=np.int32)
     hb = {"input_ids": x[:, :-1], "labels": x[:, 1:]}
@@ -593,6 +602,7 @@ def _tuner_run(res, cfg, batch, seq, state, inner: int = 8):
     snap = tuner.snapshot()
     return {
         "tuned_variant": win.name if win is not None else "default",
+        "tuned_shape_class": sc,
         "tune_windows": sum(snap["windows"].values()),
         "tune_medians_ms": {c: round(v * 1e3, 3)
                             for c, v in sorted(snap["medians"].items())},

@@ -332,7 +332,8 @@ class AccelerateResult:
         per-step batches with `data.elastic_dataset.stack_batches`, place
         with `place_fused_batch`).  Built lazily and cached per
         (K, trace-env): each K is a distinct compile, and so is each
-        DWT_FA_* variant — the toggles are read at TRACE time, so a
+        trace-env variant (DWT_FA_* layout, DWT_FP8_DENSE quant,
+        DWT_REMAT_POLICY) — the toggles are read at TRACE time, so a
         variant cutover (auto/tuner.py) MUST retrace through the factory
         rather than reuse a jit entry traced under the old env (K and the
         env values both change the HLO — auto/compile_cache.py)."""

@@ -52,7 +52,17 @@ logger = get_logger("compile_cache")
 # DWT_FA_PACK picks the flash-attention sublane pack width at trace time
 # (ops/flash_attention.py:225) — found missing by graftlint's env-at-trace
 # checker; the analysis/ self-lint keeps this tuple honest from here on.
-TRACE_ENV_VARS = ("DWT_FA_NO_FUSED", "DWT_FA_PACK", "DWT_FA_STREAMED")
+# DWT_FP8_DENSE routes the name-filtered dense projections through the
+# fp8 matmul (ops/quantization.py fp8_dense_override — numerics-changing,
+# tuner-gated behind TrainingArgs.tune_numerics) and DWT_REMAT_POLICY
+# overrides the model's remat policy (ops/remat.py trace_remat_policy);
+# both are read at TRACE time inside the model body, so registering them
+# here is what makes every fp8/remat variant a distinct compile-cache
+# key.  This tuple must stay a literal: graftlint parses it by AST
+# (analysis/ast_engine.py trace_env_key_vars) to source the protected
+# name set for env-flip-outside-tuner and env-at-trace.
+TRACE_ENV_VARS = ("DWT_FA_NO_FUSED", "DWT_FA_PACK", "DWT_FA_STREAMED",
+                  "DWT_FP8_DENSE", "DWT_REMAT_POLICY")
 
 # one registry sidecar + one pool directory per cache dir
 _REGISTRY_SUBDIR = "framework-keys"

@@ -358,13 +358,19 @@ def tuner_decision_effects(decisions: List[Dict]) -> List[Dict]:
     master's rows, so post-mortem tooling reads one history (rows with
     ``kind == "tuner"`` are local decisions, journal-free by design: the
     winner is durable in tuning.json, not in the master journal).
+
+    Loss-divergence REVERTS ride the same bridge with ``kind ==
+    "tuner-revert"`` (the tuner's kind passes through): their rows carry
+    the disqualified variant (``reverted``) and the measured
+    loss-vs-reference evidence, so an fp8 candidate thrown out of the
+    search is auditable in the same history as the eventual winner.
     """
     out: List[Dict] = []
     for d in decisions:
         did = str(d.get("decision_id", ""))
-        out.append({
+        row = {
             "decision_id": did,
-            "kind": "tuner",
+            "kind": str(d.get("kind") or "tuner"),
             "variant": str(d.get("variant", "")),
             "env": dict(d.get("env") or {}),
             "fused_steps": int(d.get("fused_steps") or 0),
@@ -374,5 +380,13 @@ def tuner_decision_effects(decisions: List[Dict]) -> List[Dict]:
                 "before": dict(d.get("before") or {}),
                 "after": dict(d.get("after") or {}),
             },
-        })
+        }
+        if d.get("shape_class"):
+            row["shape_class"] = str(d["shape_class"])
+        if d.get("reverted"):  # divergence-guard evidence
+            row["reverted"] = str(d["reverted"])
+            for k in ("loss", "loss_ref", "loss_bound"):
+                if k in d:
+                    row[k] = float(d[k])
+        out.append(row)
     return out

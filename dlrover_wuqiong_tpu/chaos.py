@@ -2345,10 +2345,13 @@ def perf_regress() -> Dict:
         # 3) key isolation across a trace-env flip + store round-trip —
         #    flipped through the tuner's sanctioned scoped writer
         #    (auto/tuner.py; graftlint env-flip-outside-tuner forbids
-        #    raw os.environ writes of TRACE_ENV_VARS names)
+        #    raw os.environ writes of TRACE_ENV_VARS names).  The flip
+        #    exercises the ISSUE-16 quant axis (DWT_FP8_DENSE) — the
+        #    numerics-changing variant must re-key exactly like the
+        #    layout-neutral DWT_FA_* toggles
         from .auto.tuner import variant_env
 
-        with variant_env({"DWT_FA_NO_FUSED": "1"}):
+        with variant_env({"DWT_FP8_DENSE": "1"}):
             flipped = executable_key("drill-fingerprint", 8, "cpu")
         # 4) tuner cutover: the flipped variant is a NEW executable key,
         #    so its windows land on a FRESH baseline — step times that

@@ -103,7 +103,8 @@ def _mad(xs: List[float], med: Optional[float] = None) -> float:
 
 # fallback when auto/compile_cache is unimportable (it is jax-free today;
 # this guards the jax-free smoke against a future jax import there)
-_TRACE_ENV_FALLBACK = ("DWT_FA_NO_FUSED", "DWT_FA_PACK", "DWT_FA_STREAMED")
+_TRACE_ENV_FALLBACK = ("DWT_FA_NO_FUSED", "DWT_FA_PACK", "DWT_FA_STREAMED",
+                       "DWT_FP8_DENSE", "DWT_REMAT_POLICY")
 
 
 def executable_key(strategy_fingerprint: str, fused_steps: int,
@@ -191,6 +192,21 @@ class BaselineStore:
     def category_medians(self, key: str) -> Dict[str, float]:
         return {cat: _median(xs)
                 for cat, xs in self._row(key)["categories"].items() if xs}
+
+    def aggregate_categories(self) -> Dict[str, float]:
+        """Per-category medians SUMMED across every executable key — the
+        coarse op-category profile (matmul vs collective vs host) of the
+        whole run so far.  The variant autotuner orders its candidate
+        matrix by this split (auto/tuner.py order_variants, ROADMAP 4d):
+        a matmul-bound profile tries quant variants first, a
+        collective-bound one tries pack/stream first.  Empty until some
+        key has categorized windows — the tuner then falls back to
+        declaration order."""
+        out: Dict[str, float] = {}
+        for key in list(self._load()["keys"]):
+            for cat, med in self.category_medians(key).items():
+                out[cat] = out.get(cat, 0.0) + med
+        return out
 
     # ----------------------------------------------------------- publish
     def publish(self) -> bool:

@@ -84,6 +84,15 @@ class TrainingArgs:
                 f"bad autotuner knobs: tune_variants={self.tune_variants} "
                 f"(>= 0; 0 = off), tune_hysteresis={self.tune_hysteresis} "
                 f"(in [0, 1))")
+        if self.tune_loss_bound <= 0.0:
+            raise ValueError(
+                f"tune_loss_bound must be > 0 (relative divergence "
+                f"margin), got {self.tune_loss_bound}")
+        if self.tune_numerics and self.tune_variants <= 0:
+            raise ValueError(
+                "tune_numerics requires the autotuner "
+                "(tune_variants > 0) — the fp8 quant axis only runs "
+                "under the loss-divergence guard")
     profile_trace_dir: str = ""              # jax.profiler window target
     profile_start_step: int = -1
     profile_end_step: int = -1
@@ -131,6 +140,16 @@ class TrainingArgs:
     # Requires the perf observatory (perf_window_every > 0).
     tune_variants: int = 0
     tune_hysteresis: float = 0.05            # challenger must win by this
+    # opt-in the NUMERICS-CHANGING quant axis (fp8 dense matmul via
+    # DWT_FP8_DENSE) into the search.  Unlike the layout-neutral
+    # DWT_FA_*/remat axes, fp8 changes the loss trajectory, so it only
+    # runs under the tuner's loss-divergence guard: a measured window
+    # whose loss rises more than tune_loss_bound (relative) above the
+    # rolling reference median auto-reverts the variant — cut back to
+    # the incumbent at the same boundary, revert journaled as a
+    # PolicyDecision-style entry.  False = fp8 never enters the search.
+    tune_numerics: bool = False
+    tune_loss_bound: float = 0.05            # relative divergence margin
     # overlap the logging boundary's host work (metrics readback, perf
     # window close, master reports) with the next fused dispatch via the
     # metrics pump thread; False = inline (sync).  User callbacks force
@@ -347,7 +366,8 @@ class Trainer:
         # start tuned.  Needs the perf observatory (windows are the
         # scorer's only signal).
         self._tuner = None
-        self._tuner_reported = False
+        self._tuner_reported = 0  # decisions surfaced so far (reverts
+        # land mid-search, the winner at the end — incremental count)
         self._variant_active = "default"
         if args.tune_variants > 0 and self._perf is not None:
             self._init_tuner()
@@ -493,12 +513,38 @@ class Trainer:
 
     # ------------------------------------------------- variant autotuner
 
+    def _model_dims_fingerprint(self) -> str:
+        """Width×depth fingerprint of the model config for shape_class
+        ("d768x12"); "" when the model exposes no recognized dims."""
+        cfg = getattr(self.model, "config", None)
+        if cfg is None:
+            return ""
+        width = getattr(cfg, "n_embd", None) or \
+            getattr(cfg, "hidden_size", None)
+        depth = getattr(cfg, "n_layer", None) or \
+            getattr(cfg, "num_layers", None)
+        if not width or not depth:
+            return ""
+        return f"d{int(width)}x{int(depth)}"
+
     def _init_tuner(self) -> None:
         """Start tuned when a winner is persisted for this executable
         family (strategy + backend, excluding the tunables); otherwise
-        build the interleaved search over the default variant space.
+        build the interleaved search over the widened variant space.
         Corrupt/missing tuning.json falls through to re-learn (the store
-        tolerates it) — never fatal."""
+        tolerates it) — never fatal.
+
+        Winner lookup is PER-SHAPE first (batch × seq × model dims —
+        ROADMAP 4c): the exact-geometry winner is preferred, the
+        family-wide winner serves unseen shapes, and v1 shapeless stores
+        keep serving as the fallback without re-learning.  The search
+        space adds the remat-policy ladder when the model remats and
+        the fp8 quant axis behind `tune_numerics` (loss-divergence
+        guard armed via `tune_loss_bound`); candidate ORDER comes from
+        the baseline store's op-category split (ROADMAP 4d) — a
+        matmul-bound profile tries quant first, a collective-bound one
+        pack/stream first.
+        """
         import jax
 
         from ..auto import tuner as vt
@@ -506,9 +552,11 @@ class Trainer:
         a = self.args
         backend = jax.default_backend()
         family = vt.family_key(self._strategy_fingerprint(), backend)
+        shape = vt.shape_class(a.global_batch_size, a.seq_len,
+                               self._model_dims_fingerprint())
         store = vt.TuningStore(
             vt.tuning_path(os.path.join(a.output_dir, "checkpoints")))
-        winner = store.lookup(family)
+        winner = store.lookup(family, shape)
         if winner is not None:
             # apply before the first dispatch: the fused cache re-keys on
             # the env signature, so this retraces exactly once and the
@@ -524,12 +572,30 @@ class Trainer:
                     (not cad or cad % k_win == 0):
                 a.fused_steps = k_win  # skip the K re-measurement too
             logger.info("tuner: starting on persisted winner %r "
-                        "(family %s)", self._variant_active, family)
+                        "(family %s, shape %s%s)", self._variant_active,
+                        family, shape,
+                        "" if winner.get("shape_class") == shape
+                        else " via family fallback")
             return
+        cfg = getattr(self.model, "config", None)
+        remat_policies = ()
+        if cfg is not None and getattr(cfg, "remat", False):
+            # only non-offload policies: offload variants change the
+            # host-transfer profile, not a pure compute trade — keep the
+            # online ladder to the HBM-resident policies
+            remat_policies = ("dots", "save_names")
+        hint = None
+        if self._perf is not None:
+            hint = self._perf.store.aggregate_categories() or None
         self._tuner = vt.VariantAutotuner(
-            vt.default_variants(backend), store=store, family=family,
+            vt.default_variants(backend, numerics=a.tune_numerics,
+                                remat_policies=remat_policies),
+            store=store, family=family,
             windows_per_variant=a.tune_variants,
-            hysteresis=a.tune_hysteresis)
+            hysteresis=a.tune_hysteresis,
+            shape_class=shape,
+            loss_bound=a.tune_loss_bound if a.tune_numerics else 0.0,
+            category_hint=hint)
         self._tuner.bind_executable_context(
             strategy_fingerprint=self._strategy_fingerprint(),
             fused_steps=max(a.fused_steps, 1), backend=backend)
@@ -555,22 +621,27 @@ class Trainer:
         tuner = self._tuner
         if tuner is None:
             return
-        if tuner.finished and not self._tuner_reported:
-            self._tuner_reported = True
+        with tuner._lock:
+            pending = list(tuner.decisions[self._tuner_reported:])
+        if pending:
+            # incremental: loss-divergence REVERTS land mid-search, the
+            # winner at the end — each surfaces exactly once
+            self._tuner_reported += len(pending)
             from ..brain.policy import tuner_decision_effects
 
-            effects = tuner_decision_effects(tuner.decisions)
+            effects = tuner_decision_effects(pending)
             self.policy_applied.extend(effects)
             if effects and self.ctx.mc is not None:
                 import json as _json
 
-                try:  # telemetry never kills the run
-                    self.ctx.mc.report_node_event(
-                        "tuner-decision",
-                        _json.dumps(effects[-1], sort_keys=True),
-                        level="info")
-                except Exception:  # noqa: BLE001
-                    pass
+                for eff in effects:
+                    try:  # telemetry never kills the run
+                        self.ctx.mc.report_node_event(
+                            "tuner-decision",
+                            _json.dumps(eff, sort_keys=True),
+                            level="info")
+                    except Exception:  # noqa: BLE001
+                        pass
         desired = tuner.current()
         if desired.name == self._variant_active:
             return
@@ -809,9 +880,11 @@ class Trainer:
                 job.get("tune_variant") == self._variant_active:
             # credit the window to the variant that actually executed it
             # (note_window is lock-guarded); the returned next candidate
-            # is picked up by the main loop's boundary poll
+            # is picked up by the main loop's boundary poll.  The loss
+            # rides along for the numerics divergence guard — it is the
+            # SAME already-read boundary loss, zero new device syncs.
             self._tuner.note_window(
-                float(snap.get("step_time_s") or 0.0))
+                float(snap.get("step_time_s") or 0.0), loss=loss)
         for cb in self.callbacks:
             cb(step, {"loss": loss, "tokens_per_sec": tps})
         return loss

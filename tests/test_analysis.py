@@ -321,9 +321,10 @@ class TestEnvAtTrace:
         vars_ = trace_env_key_vars([
             os.path.join(REPO_ROOT, "dlrover_wuqiong_tpu")])
         # the DWT_FA_PACK omission was graftlint's first real catch —
-        # pin all three kernel-path toggles in the key set
-        assert {"DWT_FA_NO_FUSED", "DWT_FA_PACK",
-                "DWT_FA_STREAMED"} <= vars_
+        # pin the kernel-path toggles plus the ISSUE-16 tuner axes
+        # (fp8 dense + remat policy) in the key set
+        assert {"DWT_FA_NO_FUSED", "DWT_FA_PACK", "DWT_FA_STREAMED",
+                "DWT_FP8_DENSE", "DWT_REMAT_POLICY"} <= vars_
 
 
 class TestEnvFlipOutsideTuner:
@@ -394,6 +395,43 @@ class TestEnvFlipOutsideTuner:
             checkers=["env-flip-outside-tuner"],
             key_vars={"DWT_FA_PACK"})
         assert found == []
+
+    def test_newly_registered_name_flagged_via_lint_time_sourcing(
+            self, tmp_path):
+        """Registering a NEW name in TRACE_ENV_VARS is all it takes for
+        the rule to cover it: key_vars are parsed from the linted tree's
+        own auto/compile_cache.py at LINT TIME (no hardcoded list), so a
+        raw write of the new toggle is flagged while the same write in
+        the tuner module stays exempt."""
+        # key-builder at <root>/auto/compile_cache.py — exactly where
+        # trace_env_key_vars looks under each scanned root
+        (tmp_path / "auto").mkdir()
+        (tmp_path / "runtime").mkdir()
+        for d in ("auto", "runtime"):
+            (tmp_path / d / "__init__.py").touch()
+        (tmp_path / "auto" / "compile_cache.py").write_text(
+            textwrap.dedent("""\
+            '''Parity: ref.py:1'''
+            TRACE_ENV_VARS = ("DWT_FA_NO_FUSED", "DWT_NEW_TOGGLE")
+            """))
+        bad = textwrap.dedent("""\
+            '''Parity: ref.py:1'''
+            import os
+
+            def go():
+                os.environ["DWT_NEW_TOGGLE"] = "1"
+            """)
+        (tmp_path / "runtime" / "flip.py").write_text(bad)
+        # the good twin: byte-identical write, but in the tuner module —
+        # the ONE sanctioned writer stays exempt
+        (tmp_path / "auto" / "tuner.py").write_text(bad)
+        # key_vars=None -> auto-sourced from the fixture tree itself
+        findings, _ = run_paths(
+            [str(tmp_path)], checkers=["env-flip-outside-tuner"])
+        assert [(f.checker, f.line) for f in findings] == \
+            [("env-flip-outside-tuner", 5)]
+        assert findings[0].path.endswith("runtime/flip.py")
+        assert "DWT_NEW_TOGGLE" in findings[0].message
 
 
 class TestWallClockDuration:

@@ -87,6 +87,20 @@ class TestBaselineStore:
         assert st.category_medians("k")["matmul"] > 60
         assert st.publish() is False  # no path → memory-only contract
 
+    def test_aggregate_categories_sums_across_keys(self):
+        # the autotuner's ordering hint (ROADMAP 4d): one coarse
+        # op-category profile over EVERY executable key
+        st = BaselineStore()
+        assert st.aggregate_categories() == {}
+        for v in (1.0, 1.0, 1.0):
+            st.update("k1", v, {"matmul": 0.8, "collective": 0.1})
+        for v in (2.0, 2.0, 2.0):
+            st.update("k2", v, {"matmul": 0.2, "host": 0.05})
+        agg = st.aggregate_categories()
+        assert agg["matmul"] == pytest.approx(1.0)  # 0.8 + 0.2
+        assert agg["collective"] == pytest.approx(0.1)
+        assert agg["host"] == pytest.approx(0.05)
+
     def test_atomic_publish_and_reload(self, tmp_path):
         path = str(tmp_path / "perf" / "baseline.json")
         st = BaselineStore(path)

@@ -6,14 +6,16 @@ the bench.py rules (host readback; chain iterations on carried values —
 `block_until_ready` is a no-op over the tunnel).
 
 Usage: python tools/perf_probe.py [attn|attn_sweep|head|model|opt|step|lib|
-dispatch|fa-variants] ...  (no args = step/attn/head/model/opt).  One JSON
-line per probe as it finishes, then ONE summary line ``{"probes": [...],
-"emitted": N}`` under the shared report-CLI contract
+dispatch|fa-variants|quant-variants] ...  (no args = step/attn/head/model/
+opt).  One JSON line per probe as it finishes, then ONE summary line
+``{"probes": [...], "emitted": N}`` under the shared report-CLI contract
 (common/report_cli.py; -h to stderr rc=0, unknown probe rc=1).
 `dispatch` measures the fused-vs-unfused dispatch-overhead win of
 the K-step driver (trainer/train_step.py) in THIS environment;
 `fa-variants` A/B-measures the DWT_FA_* kernel-variant matrix
-interleaved (same-session, chip drift) via the tuner's scorer.
+interleaved (same-session, chip drift) via the tuner's scorer;
+`quant-variants` races the dense-matmul precision ladder (f32/bf16
+vs the fp8 kernel the tuner's quant axis swaps in) the same way.
 """
 
 from __future__ import annotations
@@ -461,6 +463,72 @@ def probe_fa_variants(rounds: int = 3):
                               for n, t in sorted(meds.items())}})
 
 
+def probe_quant_variants(rounds: int = 3):
+    """Interleaved A/B over the dense-matmul precision ladder (ISSUE 16).
+
+    f32 vs bf16 vs fp8 (ops/quantization.py fp8_matmul — e4m3 fwd, e5m2
+    bwd) on one projection-shaped fwd+bwd matmul, the op the online
+    tuner's quant axis (DWT_FP8_DENSE) swaps inside the dense blocks.
+    Same discipline as `fa-variants`: a FRESH jitted function per
+    candidate (jit caches on function identity, never on the captured
+    kernel), INNER repeats chained inside one dispatch so the ~5-8ms
+    tunnel tax amortizes out, and `InterleavedScorer` medians over
+    same-session interleaved rounds (±10% chip-load drift).  On CPU the
+    fp8 path lowers to dequantized f32 emulation and typically LOSES —
+    that honest negative result is exactly why the online tuner, not a
+    static flag, owns the decision on real hardware."""
+    from dlrover_wuqiong_tpu.auto import tuner as vt
+    from dlrover_wuqiong_tpu.ops.quantization import fp8_matmul
+
+    if jax.default_backend() == "tpu":
+        m = n = kdim = 4096
+    else:  # runnable anywhere: small shape keeps CPU emulation fast
+        m = n = kdim = 256
+    ka, kb = jax.random.split(jax.random.PRNGKey(7))
+    a32 = jax.random.normal(ka, (m, kdim), jnp.float32)
+    b32 = jax.random.normal(kb, (kdim, n), jnp.float32)
+
+    def _make(mm, dtype):
+        a, b = a32.astype(dtype), b32.astype(dtype)
+
+        @jax.jit
+        def fwdbwd(args):
+            a, b = args
+
+            def loss(a, b):
+                return mm(a, b).astype(jnp.float32).sum()
+
+            for _ in range(INNER):
+                da, db = jax.grad(loss, argnums=(0, 1))(a, b)
+                a, b = da.astype(a.dtype), db.astype(b.dtype)
+            return (a, b)
+
+        return fwdbwd, (a, b)
+
+    cands = {
+        "dense-f32": _make(jnp.matmul, jnp.float32),
+        "dense-bf16": _make(jnp.matmul, jnp.bfloat16),
+        "fp8": _make(lambda a, b: fp8_matmul(a, b, jnp.bfloat16),
+                     jnp.bfloat16),
+    }
+    for fn, args in cands.values():  # compile before any timing
+        _sync(fn(args))
+
+    scorer = vt.InterleavedScorer(list(cands), min_samples=rounds)
+    while not scorer.complete():
+        name = scorer.next_candidate()
+        fn, args = cands[name]
+        t = _time(fn, args, iters=2, warmup=1) / INNER
+        scorer.note(name, t)
+    meds = scorer.medians()
+    winner, decided = scorer.winner(incumbent="dense-bf16")
+    _emit_raw({"probe": "quant_variants", "winner": winner,
+               "decided": decided, "rounds": rounds, "interleaved": True,
+               "mnk": [m, n, kdim],
+               "medians_ms": {name: round(t * 1e3, 3)
+                              for name, t in sorted(meds.items())}})
+
+
 def probe_splash():
     """jax splash-attention (newer vmapped MQA-style kernel) — causal."""
     try:
@@ -551,7 +619,8 @@ ALL = {"attn": probe_attn, "attn_sweep": probe_attn_sweep, "lib": probe_lib,
        "splash": probe_splash, "dots": probe_dots,
        "head": probe_head, "model": probe_model, "opt": probe_opt,
        "step": probe_step, "dispatch": probe_dispatch,
-       "fa-variants": probe_fa_variants}
+       "fa-variants": probe_fa_variants,
+       "quant-variants": probe_quant_variants}
 
 
 def main(argv=None) -> int:

@@ -26,7 +26,10 @@ Offline ``--tuning`` reads the variant-autotuner winner store at
 $CKPT_DIR/perf/tuning.json (auto/tuner.py TuningStore — same atomic
 publish discipline) and reports the persisted winner per executable
 family: variant name, its env/fused-K, the measured per-candidate
-medians and the winner's full executable key.  Live mode carries the
+medians, the winner's full executable key, and (schema 2) the
+per-geometry winners under each family's ``shape_classes`` map —
+the flat family fields stay the shape-agnostic fallback, so
+pre-shape consumers keep working unchanged.  Live mode carries the
 same signal per node: every PerfQuery snapshot includes the ADD-ONLY
 ``tuned_variant`` field, surfaced as the report's ``tuned_variants``
 map.
@@ -130,20 +133,36 @@ def _from_tuning(path: str) -> dict:
         raise FileNotFoundError(
             f"--tuning: no autotuner winner store at {cand!r}")
     rows = TuningStore(cand).rows()
-    families = {}
-    for fam in sorted(rows):
-        r = rows[fam]
-        families[fam] = {
+
+    def _rec(r):
+        return {
             "variant": str(r.get("variant", "")),
             "env": dict(r.get("env") or {}),
             "fused_steps": int(r.get("fused_steps") or 0),
             "windows": int(r.get("windows") or 0),
             "executable_key": str(r.get("executable_key", "")),
+            "shape_class": str(r.get("shape_class", "")),
             "medians_s": {name: round(float(m), 6) for name, m in
                           sorted((r.get("medians") or {}).items())},
         }
+
+    # v2 nested store: the family winner's fields stay FLAT in the
+    # row (report schema is ADD-ONLY — pre-shape consumers keep
+    # reading winners[fam]["variant"]) with the per-geometry winners
+    # under "shape_classes"
+    families = {}
+    n_shapes = 0
+    for fam in sorted(rows):
+        row = rows[fam]
+        winner = row.get("winner") or {}
+        shapes = row.get("shapes") or {}
+        n_shapes += len(shapes)
+        families[fam] = dict(_rec(winner),
+                             shape_classes={s: _rec(r) for s, r
+                                            in sorted(shapes.items())})
     return {"source": "tuning", "path": cand,
-            "families": len(families), "winners": families}
+            "families": len(families), "shape_classes": n_shapes,
+            "winners": families}
 
 
 def main(argv=None) -> int:
