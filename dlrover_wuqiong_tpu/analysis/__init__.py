@@ -3,7 +3,7 @@
 Parity: reference `dlrover/python/diagnosis/` + `elastic_agent/monitor/`
 (error_monitor.py:1, node_check.py:1) diagnose distributed failures at
 RUNTIME; graftlint moves the TPU-costly bug classes to a pre-execution
-contract.  Five engines share one finding model + rule catalog
+contract.  Six engines share one finding model + rule catalog
 (findings.RULE_CATALOG):
 
 - `ast_engine` scans source text: trace-time ``DWT_*`` env reads
@@ -15,6 +15,11 @@ contract.  Five engines share one finding model + rule catalog
 - `concurrency_engine` checks lock discipline on the same call-graph
   machinery: blocking-under-lock, lock-order cycles, unguarded
   shared state across threads, thread lifecycles.
+- `schema_engine` extracts the full wire surface (message dataclasses,
+  ADD-ONLY registries, verb classes, journal kinds vs replay branches,
+  snapshot export/restore keys) and diffs it against the committed
+  `analysis/schema.lock.json` — removals/renames/default changes are
+  errors; additions require ``--update-lock``.
 - `jaxpr_engine` inspects traced train steps without executing them:
   collective-in-cond deadlocks, CSE-undone remat, donation vs
   optimizer_offload aliasing, host-kind out_shardings.
@@ -22,12 +27,13 @@ contract.  Five engines share one finding model + rule catalog
   collective-op counts against checked-in analytic budgets.
 
 CLI: ``python -m dlrover_wuqiong_tpu.analysis [--engine
-jaxpr|ast|protocol|concurrency|hlo|all] [--format json|sarif]
-[path...]`` — single-line JSON (or SARIF) summary on stdout (bench.py
-contract), file:line findings on stderr, exit 1 on any non-warning
-finding.  This module and the ast/protocol/concurrency engines import
-no jax so ``__graft_entry__.py`` can pre-flight them before any
-backend initialization; jaxpr/hlo are imported lazily.
+jaxpr|ast|protocol|concurrency|schema|hlo|all] [--format json|sarif]
+[--update-lock] [path...]`` — single-line JSON (or SARIF) summary on
+stdout (bench.py contract), file:line findings on stderr, exit 1 on
+any non-warning finding.  This module and the
+ast/protocol/concurrency/schema engines import no jax so
+``__graft_entry__.py`` can pre-flight them before any backend
+initialization; jaxpr/hlo are imported lazily.
 """
 
 from .ast_engine import run_paths as run_ast_engine  # noqa: F401

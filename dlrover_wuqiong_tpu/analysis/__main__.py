@@ -7,17 +7,21 @@ on stdout (machine-readable for CI/driver), human findings on stderr,
 exit code 1 when any rule is violated.
 
 Engine selection: ``--engine ast`` / ``--engine protocol`` /
-``--engine concurrency`` need no jax at all (the `__graft_entry__.py`
-pre-flight runs all three); ``--engine jaxpr`` / ``--engine hlo``
-self-provision a virtual CPU platform (the audit/budget meshes need 8
-devices) BEFORE jax initializes any backend, so running them on a
-machine with a live TPU tunnel never touches a chip.  ``--changed``
-restricts the file-scanning engines to the git diff (fast CI mode; the
-whole-program jaxpr/hlo engines are skipped).  ``--catalog`` prints the
-rule catalog as the one JSON line and exits 0.  ``--format sarif``
-swaps the stdout line for a SARIF 2.1.0 document (still exactly one
-line) so CI annotates findings in place; exit code semantics are
-unchanged.
+``--engine concurrency`` / ``--engine schema`` need no jax at all (the
+`__graft_entry__.py` pre-flight runs all four); ``--engine jaxpr`` /
+``--engine hlo`` self-provision a virtual CPU platform (the
+audit/budget meshes need 8 devices) BEFORE jax initializes any
+backend, so running them on a machine with a live TPU tunnel never
+touches a chip.  ``--changed`` restricts the file-scanning engines to
+the git diff (fast CI mode; the whole-program jaxpr/hlo engines are
+skipped — schema still runs: its fixed-file extraction is pure AST and
+cheap).  ``--catalog`` prints the rule catalog as the one JSON line
+and exits 0.  ``--format sarif`` swaps the stdout line for a SARIF
+2.1.0 document (still exactly one line) so CI annotates findings in
+place; exit code semantics are unchanged.  ``--update-lock``
+regenerates ``analysis/schema.lock.json`` from the extracted wire
+surface (forces the schema engine on) instead of diffing against it —
+internal-consistency errors still gate.
 
 The JSON schema is a compatibility contract (tests/test_analysis.py
 pins it): keys are only ever ADDED to the ``graftlint`` object.
@@ -90,7 +94,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "control-plane-protocol checks")
     parser.add_argument("--engine",
                         choices=("jaxpr", "ast", "protocol", "concurrency",
-                                 "hlo", "all"),
+                                 "schema", "hlo", "all"),
                         default="all")
     parser.add_argument("--format", choices=("json", "sarif"),
                         default="json",
@@ -104,9 +108,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="cap on stderr finding lines")
     parser.add_argument("--changed", action="store_true",
                         help="fast mode: scan only git-diff'd .py files "
-                             "with the ast+protocol+concurrency engines "
-                             "(jaxpr/hlo are whole-program and are "
-                             "skipped)")
+                             "with the ast+protocol+concurrency+schema "
+                             "engines (jaxpr/hlo are whole-program and "
+                             "are skipped)")
+    parser.add_argument("--update-lock", action="store_true",
+                        help="regenerate analysis/schema.lock.json from "
+                             "the extracted wire surface (forces the "
+                             "schema engine; deterministic sorted-keys "
+                             "JSON, atomic tmp+rename) instead of "
+                             "diffing against it")
     parser.add_argument("--catalog", action="store_true",
                         help="print the rule catalog as the one JSON "
                              "line and exit")
@@ -155,6 +165,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         files_scanned = max(files_scanned, n_files)
         findings.extend(conc_findings)
         engines.append("concurrency")
+    schema_summary = None
+    if (args.engine in ("schema", "all") and run_file_engines) \
+            or args.update_lock:
+        from .schema_engine import run_schema
+
+        schema_findings, schema_summary = run_schema(
+            update_lock=args.update_lock)
+        findings.extend(schema_findings)
+        engines.append("schema")
     if args.engine in ("jaxpr", "all") and run_trace_engines:
         _provision_cpu(args.devices)
         from .jaxpr_engine import self_audit
@@ -179,21 +198,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(json.dumps(to_sarif(findings)))
         return 1 if gating else 0
     # bench.py contract: exactly one JSON line on stdout.  Schema
-    # evolution is ADD-ONLY (tests/test_analysis.py pins it).
-    print(json.dumps({
-        "graftlint": {
-            "engines": engines,
-            "files_scanned": files_scanned,
-            "findings": len(findings),
-            "by_checker": summarize(findings),
-            "by_severity": summarize_severity(findings),
-            "hlo_collectives": {
-                tag: {op: dict(v) for op, v in sorted(ops.items())}
-                for tag, ops in sorted(hlo_measured.items())},
-            "elapsed_s": round(time.monotonic() - t0, 2),
-            "ok": not gating,
-        }
-    }))
+    # evolution is ADD-ONLY (tests/test_analysis.py pins it); the
+    # ``schema`` section only appears when the schema engine ran.
+    record = {
+        "engines": engines,
+        "files_scanned": files_scanned,
+        "findings": len(findings),
+        "by_checker": summarize(findings),
+        "by_severity": summarize_severity(findings),
+        "hlo_collectives": {
+            tag: {op: dict(v) for op, v in sorted(ops.items())}
+            for tag, ops in sorted(hlo_measured.items())},
+        "elapsed_s": round(time.monotonic() - t0, 2),
+        "ok": not gating,
+    }
+    if schema_summary is not None:
+        record["schema"] = schema_summary
+    print(json.dumps({"graftlint": record}))
     return 1 if gating else 0
 
 

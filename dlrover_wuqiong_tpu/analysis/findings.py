@@ -267,6 +267,63 @@ RULE_CATALOG: Dict[str, Dict[str, str]] = {
                      "'finished' job keeps its pod alive; mark it daemon "
                      "or join it from stop()",
     },
+    # ---- schema engine (wire-schema compatibility vs the lockfile)
+    "schema-removed": {
+        "engine": "schema", "severity": "error",
+        "rationale": "a wire message/field/registry member/verb/replayed "
+                     "journal kind present in schema.lock.json is gone — "
+                     "old-generation peers still send it and old journals "
+                     "still hold it; ADD-ONLY schemas never remove",
+    },
+    "schema-renamed": {
+        "engine": "schema", "severity": "error",
+        "rationale": "a locked name was replaced by a new one at the "
+                     "same ordinal slot — a rename is a remove+add on "
+                     "the wire; add the new name alongside and keep the "
+                     "old one decoding",
+    },
+    "schema-default-changed": {
+        "engine": "schema", "severity": "error",
+        "rationale": "frames from old peers OMIT defaulted fields — "
+                     "changing the default silently changes what those "
+                     "frames mean on decode (sentinels like 0/-1/'' are "
+                     "part of the wire contract)",
+    },
+    "schema-field-no-sentinel": {
+        "engine": "schema", "severity": "error",
+        "rationale": "the codec drops unknown fields on decode, so "
+                     "mixed-generation decode only works when every "
+                     "message field has a no-change default; a "
+                     "sentinel-less field breaks rolling upgrades",
+    },
+    "schema-lock-stale": {
+        "engine": "schema", "severity": "error",
+        "rationale": "the extracted wire surface differs from the "
+                     "committed schema.lock.json — additions are legal "
+                     "but must be locked in the same PR (--update-lock) "
+                     "so the schema delta is a reviewed diff",
+    },
+    "schema-lock-corrupt": {
+        "engine": "schema", "severity": "warning",
+        "rationale": "schema.lock.json is unreadable — the engine "
+                     "re-extracts and skips the diff rather than "
+                     "failing the gate on a torn artifact; regenerate "
+                     "with --update-lock",
+    },
+    "journal-kind-unreplayed": {
+        "engine": "schema", "severity": "error",
+        "rationale": "a journal kind the servicer/master appends with "
+                     "no replay branch in _apply_entry is silent state "
+                     "loss at the next failover — every acked mutation "
+                     "of that kind vanishes on restart",
+    },
+    "snapshot-asymmetric": {
+        "engine": "schema", "severity": "warning",
+        "rationale": "a snapshot key exported by _journal_state but "
+                     "never read by _restore_snapshot (or vice versa) "
+                     "means compaction silently drops state — the "
+                     "export/restore key sets must stay symmetric",
+    },
     # ---- jaxpr engine (trace-level)
     "collective-in-cond": {
         "engine": "jaxpr", "severity": "error",

@@ -20,9 +20,27 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+import json  # noqa: E402
 import threading  # noqa: E402
 
 import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def schema_lock():
+    """The committed wire-surface lockfile (analysis/schema.lock.json).
+
+    The ADD-ONLY pin tests assert the LIVE registries/messages still
+    cover the locked surface, so the lock is the single source of truth
+    for what "add-only" means; graftlint's schema engine gates the lock
+    itself against the source tree.  Each family keeps ONE hand-pinned
+    canary so a bad `--update-lock` regeneration can't silently shrink
+    both sides at once."""
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "dlrover_wuqiong_tpu", "analysis", "schema.lock.json")
+    with open(path) as f:
+        return json.load(f)
 
 #: thread-name prefixes tests may legitimately leave running: pytest/
 #: plugin internals plus library pools that outlive a single test by

@@ -479,7 +479,7 @@ class TestRuleCatalog:
         # engines may only emit rule ids the catalog documents
         for rule_id, entry in RULE_CATALOG.items():
             assert entry["engine"] in ("ast", "protocol", "concurrency",
-                                       "jaxpr", "hlo")
+                                       "schema", "jaxpr", "hlo")
             assert entry["severity"] in ("error", "warning")
             assert len(entry["rationale"]) > 20
 
@@ -524,6 +524,27 @@ class TestCliV2:
         assert isinstance(rec["elapsed_s"], float)
         assert isinstance(rec["ok"], bool)
 
+    def test_json_schema_section_when_schema_engine_runs(self, capsys):
+        """ADD-only evolution: the ``schema`` key appears exactly when
+        the schema engine ran, on top of the pinned base key set."""
+        from dlrover_wuqiong_tpu.analysis.__main__ import main
+
+        rc = main(["--engine", "schema"])
+        out = capsys.readouterr().out.strip().splitlines()
+        assert rc == 0 and len(out) == 1
+        rec = json.loads(out[0])["graftlint"]
+        assert set(rec) == {"engines", "files_scanned", "findings",
+                            "by_checker", "by_severity",
+                            "hlo_collectives", "elapsed_s", "ok",
+                            "schema"}
+        assert rec["engines"] == ["schema"]
+        assert set(rec["schema"]) == {"surface", "lock"}
+        assert rec["schema"]["lock"] == "ok"
+        counts = rec["schema"]["surface"]
+        assert counts["messages"] > 0 and counts["fields"] > 0
+        assert set(counts["verbs"]) == {"journaled", "idem",
+                                        "buffered", "polling"}
+
     def test_protocol_violation_rc1(self, tmp_path, capsys):
         from dlrover_wuqiong_tpu.analysis.__main__ import main
 
@@ -557,8 +578,8 @@ class TestCliV2:
         out = capsys.readouterr().out.strip()
         rec = json.loads(out)["graftlint"]
         assert rc == 0
-        assert rec["engines"] == ["ast", "protocol",
-                                  "concurrency"]  # no jaxpr/hlo
+        assert rec["engines"] == ["ast", "protocol", "concurrency",
+                                  "schema"]  # no jaxpr/hlo
 
     def test_changed_paths_smoke(self):
         from dlrover_wuqiong_tpu.analysis.__main__ import _changed_paths

@@ -473,25 +473,23 @@ class TestCompileCacheMetricsExport:
 class TestAddOnlySchemas:
     # ADD-ONLY: every consumer (flight dumps, PerfSnapshotReport,
     # tools/perf_report.py, incident timeline) keys into these dicts —
-    # extend the tuples, never rename or remove members.
-    PINNED_SNAPSHOT = {
-        "schema", "key", "step", "fused_k", "step_time_s",
-        "baseline_median_s", "baseline_mad_s", "baseline_n", "categories",
-        "overhead_s", "overhead_frac", "windows", "skipped",
-        "cache_hits", "cache_misses", "retraces", "regressions",
-        "last_event", "captured_at"}
-    PINNED_EVENT = {
-        "kind", "key", "step", "step_time_s", "baseline_median_s",
-        "baseline_mad_s", "deviation", "consecutive", "category",
-        "category_delta_s"}
+    # extend the tuples, never rename or remove members.  Pin source of
+    # truth: the committed wire-surface lockfile (analysis/
+    # schema.lock.json, gated by graftlint's schema engine) — only the
+    # canaries are hand-pinned.  PERF_EVIDENCE_KEYS is a diagnosis-
+    # internal surface (not on the wire), so it stays fully hand-pinned.
     PINNED_EVIDENCE = {"source", "step", "key", "step_time_s",
                        "categories"}
 
-    def test_snapshot_keys_add_only(self):
-        assert self.PINNED_SNAPSHOT.issubset(set(PERF_SNAPSHOT_KEYS))
+    def test_snapshot_keys_add_only(self, schema_lock):
+        locked = set(schema_lock["registries"]["PERF_SNAPSHOT_KEYS"])
+        assert locked.issubset(set(PERF_SNAPSHOT_KEYS))
+        assert "step_time_s" in PERF_SNAPSHOT_KEYS   # hand-pinned canary
 
-    def test_event_keys_add_only(self):
-        assert self.PINNED_EVENT.issubset(set(PERF_EVENT_KEYS))
+    def test_event_keys_add_only(self, schema_lock):
+        locked = set(schema_lock["registries"]["PERF_EVENT_KEYS"])
+        assert locked.issubset(set(PERF_EVENT_KEYS))
+        assert "deviation" in PERF_EVENT_KEYS   # hand-pinned canary
 
     def test_diagnosis_evidence_keys_add_only(self):
         from dlrover_wuqiong_tpu.diagnosis.manager import (

@@ -227,15 +227,19 @@ class TestPolicyDecisionSchema:
     # ADD-ONLY (like the telemetry schemas, tests/test_telemetry.py):
     # trainers/agents/report tools key off these names and old journals
     # must replay into new masters — extend, never rename or remove.
-    PINNED = {"decision_id", "ckpt_interval_steps", "replica_count",
-              "fused_steps", "recovery_route", "preferred_tier",
-              "preempt_rate_per_hr", "reason", "issued_at"}
-
-    def test_decision_fields_add_only(self):
+    # Pin source of truth: analysis/schema.lock.json (graftlint schema
+    # engine); the no-change-sentinel test below is the hand-pinned
+    # canary.
+    def test_decision_fields_add_only(self, schema_lock):
+        locked = schema_lock["messages"]["PolicyDecision"]["fields"]
         names = {f.name for f in dataclasses.fields(msg.PolicyDecision)}
-        assert names >= self.PINNED
-        missing = self.PINNED - names
+        missing = {f["name"] for f in locked} - names
         assert not missing, f"ADD-ONLY schema lost fields: {missing}"
+        # every wire field carries a no-change sentinel default — the
+        # codec drops unknown fields, so this is what makes old journals
+        # replayable into new masters (schema-field-no-sentinel rule)
+        assert all(f["sentinel"] for f in locked)
+        assert "decision_id" in names   # hand-pinned canary
 
     def test_no_change_sentinels(self):
         d = msg.PolicyDecision()

@@ -58,17 +58,16 @@ class _FakeClock:
 
 
 class TestGoodputLedger:
-    #: the v1 state list — ADD-ONLY: every name here must stay forever
-    #: (master aggregation, /metrics labels, goodput_report and the
-    #: chaos drills key on them); new states append, never rename
-    V1_STATES = (
-        "productive", "dispatch_overhead", "data_stall", "ckpt_stage",
-        "ckpt_persist", "restore_shm", "restore_replica",
-        "restore_storage", "compile", "rework", "degraded")
-
-    def test_states_schema_add_only(self):
-        for name in self.V1_STATES:
-            assert name in LEDGER_STATES, f"removed ledger state {name!r}"
+    # ADD-ONLY: every locked name must stay forever (master aggregation,
+    # /metrics labels, goodput_report and the chaos drills key on them);
+    # new states append, never rename.  The pin source of truth is the
+    # committed wire-surface lockfile (analysis/schema.lock.json, gated
+    # by graftlint's schema engine) — only the canary is hand-pinned.
+    def test_states_schema_add_only(self, schema_lock):
+        locked = schema_lock["registries"]["LEDGER_STATES"]
+        missing = set(locked) - set(LEDGER_STATES)
+        assert not missing, f"removed ledger state(s) {missing}"
+        assert "productive" in LEDGER_STATES   # hand-pinned canary
         assert LEDGER_SCHEMA_VERSION >= 1
 
     def test_snapshot_keys_add_only(self):

@@ -174,22 +174,26 @@ class TestJournalEvents:
 
 
 class TestAddOnlySchemas:
-    #: v1 event envelope — ADD-ONLY: the drills, incident_report and the
-    #: Perfetto export key on these; new keys append, never rename
-    V1_EVENT_KEYS = ("schema", "source", "kind", "name", "t_wall",
-                     "epoch", "seq", "role", "pid", "trace_id",
-                     "span_id", "dur_s", "data")
-
-    def test_event_keys_add_only(self):
-        for k in self.V1_EVENT_KEYS:
+    # Event envelope — ADD-ONLY: the drills, incident_report and the
+    # Perfetto export key on these; new keys append, never rename.
+    # Pin source of truth: analysis/schema.lock.json (graftlint schema
+    # engine); one hand-pinned canary per surface.
+    def test_event_keys_add_only(self, schema_lock):
+        for k in schema_lock["registries"]["TIMELINE_EVENT_KEYS"]:
             assert k in TIMELINE_EVENT_KEYS, f"removed event key {k!r}"
+        assert "trace_id" in TIMELINE_EVENT_KEYS   # hand-pinned canary
         assert TIMELINE_SCHEMA_VERSION >= 1
 
-    def test_timeline_messages_add_only(self):
+    def test_timeline_messages_add_only(self, schema_lock):
+        locked_q = {f["name"] for f in
+                    schema_lock["messages"]["TimelineQuery"]["fields"]}
         q = {f.name for f in dataclasses.fields(msg.TimelineQuery)}
-        assert {"node_id", "ckpt_dir"} <= q
+        assert locked_q <= q
+        locked_r = {f["name"] for f in
+                    schema_lock["messages"]["TimelineResponse"]["fields"]}
         r = {f.name for f in dataclasses.fields(msg.TimelineResponse)}
-        assert {"content", "events"} <= r
+        assert locked_r <= r
+        assert {"content", "events"} <= r   # hand-pinned canary
 
     def test_timeline_query_never_journaled(self):
         # POLLING class: a read-only assembly must not grow the journal
