@@ -273,6 +273,18 @@ def _main():
         side.update(serve_report)
     except Exception as e:  # noqa: BLE001
         side["serve_error"] = repr(e)[:300]
+
+    # control plane: synthetic-fleet RPC benchmark (ISSUE 18 tentpole) —
+    # 200 threaded clients vs one spawned master, group-commit journal
+    # A/B'd against the per-frame-fsync baseline.  CPU-only by design
+    # (no accelerator anywhere in the path), so it runs identically here
+    # and in CI
+    fleet_report = {}
+    try:
+        fleet_report = _fleet_run()
+        side.update(fleet_report)
+    except Exception as e:  # noqa: BLE001
+        side["fleet_error"] = repr(e)[:300]
     flops_per_token = None
     if n_params:
         side["params"] = n_params
@@ -428,6 +440,14 @@ def _main():
         line.update({k: tune_report[k] for k in
                      ("tuned_variant", "tuned_shape_class",
                       "tune_windows")})
+    if fleet_report:
+        # add-only control-plane keys: aggregate + journaled-verb RPC
+        # throughput under group commit, the latency tail, the win over
+        # the per-frame-fsync baseline, and frames-per-fsync evidence
+        line.update({k: fleet_report[k] for k in
+                     ("fleet_rpc_per_s", "fleet_rpc_p99_ms",
+                      "fleet_journaled_rpc_per_s", "fleet_vs_perframe",
+                      "journal_batch_mean")})
     if trace_report.get("device_op_categories"):
         # add-only: the device-op category split of the headline step
         # (DWT_BENCH_TRACE_DIR window) rides the same line so the
@@ -716,6 +736,41 @@ def _serving_run(n: int = 16, max_new: int = 24):
         "serve_requests": n,
         "serve_max_new_tokens": max_new,
         "serve_slots": spec.max_slots,
+    }
+
+
+def _fleet_run(clients: int = 200, procs: int = 8,
+               duration_s: float = 3.0) -> dict:
+    """Synthetic-fleet RPC bench in a SUBPROCESS (ISSUE 18 tentpole).
+
+    Shells out to ``python -m dlrover_wuqiong_tpu.fleet_bench`` so the
+    spawn'd client workers re-import that light module instead of this
+    jax-loaded one (spawn re-imports the parent's __main__).  Headline
+    keys are the group-commit side; the per-frame baseline and batch
+    gauges ride the side channel via the full report.
+    """
+    import subprocess
+
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=repo_root + os.pathsep +
+               os.environ.get("PYTHONPATH", ""))
+    proc = subprocess.run(
+        [sys.executable, "-m", "dlrover_wuqiong_tpu.fleet_bench",
+         f"--clients={clients}", f"--procs={procs}",
+         f"--duration-s={duration_s}", "--rounds=1"],
+        env=env, capture_output=True, text=True, timeout=600, check=True)
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    return {
+        "fleet_clients": out["clients"],
+        "fleet_fsync_floor_ms": out["fsync_floor_ms"],
+        "fleet_rpc_per_s": out["grouped"]["rpc_per_s"],
+        "fleet_rpc_p99_ms": out["grouped"]["rpc_p99_ms"],
+        "fleet_journaled_rpc_per_s":
+            out["grouped"]["journaled"]["rpc_per_s"],
+        "fleet_vs_perframe": out["journaled_speedup"],
+        "journal_batch_mean": out["grouped"]["journal"]["batch_mean"],
+        "fleet_detail": out,
     }
 
 

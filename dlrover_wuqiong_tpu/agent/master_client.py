@@ -387,6 +387,10 @@ class MasterClient:
         """Job-level perf aggregation (tools/perf_report.py)."""
         return self._call_polling("get", msg.PerfQuery())
 
+    def get_journal_stats(self) -> msg.JournalStats:
+        """Journal group-commit gauges (fleet bench / perf_probe rpc)."""
+        return self._call_polling("get", msg.JournalStatsQuery())
+
     # ------------------------------------------------------ adaptive policy
 
     def report_policy_decision(self, decision: msg.PolicyDecision

@@ -24,6 +24,12 @@ Rules (catalog + severities in findings.RULE_CATALOG):
   no-mutation paths by construction (task-exhausted, not-created) and
   are tolerated; the regression this catches is a new mutating verb
   acked without any append, or an append moved below the ack.
+  **Group-commit shape**: an ack gated on the journal's durable
+  watermark counts as the append reaching the ack — a branch (or its
+  helper, transitively) that calls ``journal.append_nowait`` must also
+  reach ``journal.wait_durable`` before the final return; an async
+  enqueue with NO durable-wait gate is flagged (the ack would race the
+  batch leader's fsync, un-doing journal-before-ack under a crash).
 - ``idem-key-required``: verbs in IDEM_VERBS are retried across master
   restarts and must thread an idempotency key end to end — the servicer
   branch's journal call must carry ``idem=``, and the MasterClient
@@ -244,6 +250,15 @@ def _mark_effects(graph: ModuleGraph):
             if term == "append" and _dotted(child.func) and \
                     "journal" in _dotted(child.func):
                 info.effects.add("journal-append")
+            # group-commit split shape: enqueue + durable-watermark gate
+            # are separate effects; only their CONJUNCTION equals a
+            # synchronous journal append (check_servicer_protocol)
+            if term == "append_nowait" and _dotted(child.func) and \
+                    "journal" in _dotted(child.func):
+                info.effects.add("journal-append-async")
+            if term == "wait_durable" and _dotted(child.func) and \
+                    "journal" in _dotted(child.func):
+                info.effects.add("journal-durable-wait")
             if term in MANIFEST_PUBLISHERS:
                 info.effects.add("manifest-publish")
             if term in COMMIT_EVIDENCE:
@@ -275,18 +290,38 @@ def _isinstance_verb(test: ast.AST) -> Set[str]:
     return out
 
 
-def _branch_journal_calls(branch: List[ast.stmt], graph: ModuleGraph,
-                          cls: Optional[str]) -> List[ast.Call]:
-    """Calls inside `branch` that transitively reach a journal append."""
-    out = []
+def _branch_journal_calls(
+        branch: List[ast.stmt], graph: ModuleGraph, cls: Optional[str]
+) -> Tuple[List[ast.Call], List[ast.Call], List[ast.Call]]:
+    """Journal-reaching calls inside `branch`, by durability shape.
+
+    Returns ``(complete, async_only, wait_only)``: *complete* calls
+    transitively reach a synchronous append OR both halves of the
+    group-commit pair (append_nowait + wait_durable — self._journal);
+    *async_only* reach just the enqueue (ack would race the batch
+    leader's fsync); *wait_only* reach just the durable-watermark gate
+    (pairs an earlier async enqueue into a complete shape).
+    """
+    complete: List[ast.Call] = []
+    async_only: List[ast.Call] = []
+    wait_only: List[ast.Call] = []
     for stmt in branch:
         for child in ast.walk(stmt):
-            if isinstance(child, ast.Call):
-                target = graph.resolve(child, cls)
-                if target and "journal-append" in \
-                        graph.transitive_effects(target):
-                    out.append(child)
-    return out
+            if not isinstance(child, ast.Call):
+                continue
+            target = graph.resolve(child, cls)
+            if not target:
+                continue
+            effs = graph.transitive_effects(target)
+            has_async = "journal-append-async" in effs
+            has_wait = "journal-durable-wait" in effs
+            if "journal-append" in effs or (has_async and has_wait):
+                complete.append(child)
+            elif has_async:
+                async_only.append(child)
+            elif has_wait:
+                wait_only.append(child)
+    return complete, async_only, wait_only
 
 
 def _stmt_index_of(branch: List[ast.stmt], node: ast.AST) -> int:
@@ -321,8 +356,26 @@ def check_servicer_protocol(path: str, tree: ast.Module,
                 continue
             verb = sorted(journaled)[0]
             branch = node.body
-            jcalls = _branch_journal_calls(branch, graph, info.cls)
-            if not jcalls:
+            complete, async_only, wait_only = _branch_journal_calls(
+                branch, graph, info.cls)
+            if async_only and wait_only:
+                # split group-commit shape assembled IN the branch: the
+                # enqueue and the watermark gate are separate helpers —
+                # the wait calls are the durability completion points
+                complete = complete + wait_only
+            elif async_only and not complete:
+                if not is_suppressed(source_lines, node.lineno,
+                                     "journal-before-ack"):
+                    findings.append(Finding(
+                        "journal-before-ack",
+                        f"servicer branch for {verb} enqueues a journal "
+                        f"frame (append_nowait) but never gates the ack "
+                        f"on journal.wait_durable — under group commit "
+                        f"the response can leave before the batch "
+                        f"leader's fsync, losing journal-before-ack",
+                        path, node.lineno))
+                continue
+            if not complete:
                 if not is_suppressed(source_lines, node.lineno,
                                      "journal-before-ack"):
                     findings.append(Finding(
@@ -338,7 +391,7 @@ def check_servicer_protocol(path: str, tree: ast.Module,
             returns = [s for s in branch if isinstance(s, ast.Return)]
             if returns:
                 last_ret = returns[-1]
-                j_idx = max(_stmt_index_of(branch, c) for c in jcalls)
+                j_idx = max(_stmt_index_of(branch, c) for c in complete)
                 r_idx = _stmt_index_of(branch, last_ret)
                 if 0 <= r_idx < j_idx and not is_suppressed(
                         source_lines, last_ret.lineno,
@@ -350,12 +403,13 @@ def check_servicer_protocol(path: str, tree: ast.Module,
                         f"append must precede the response frame",
                         path, last_ret.lineno))
             if verb in IDEM_VERBS:
+                # the idem key rides the APPEND call (sync or async half)
                 carries = any(
                     any(kw.arg == "idem" and not (
                         isinstance(kw.value, ast.Constant)
                         and kw.value.value is None)
                         for kw in c.keywords)
-                    for c in jcalls)
+                    for c in complete + async_only)
                 if not carries and not is_suppressed(
                         source_lines, node.lineno, "idem-key-required"):
                     findings.append(Finding(

@@ -1932,6 +1932,15 @@ def master_kill(dataset_size: int = 576, batch: int = 4,
         mc = MasterClient(addr, node_id=-1)
         try:
             live = mc.get_timeline(ckpt_dir=ckpt_dir)
+            # the restarted master must be running the group-commit
+            # journal (the default): the drill's exactly-once claims
+            # below hold UNDER batched fsync, not just per-frame
+            js = mc.get_journal_stats()
+            report["journal_group_commit"] = {
+                "enabled": js.enabled, "group_commit": js.group_commit,
+                "max_frames": js.max_frames,
+                "batch_mean": round(js.batch_mean, 2),
+                "durable_seq": js.durable_seq}
         finally:
             mc.close()
         offline = tl.assemble_incident(journal_dir=journal_dir,
@@ -1984,7 +1993,9 @@ def master_kill(dataset_size: int = 576, batch: int = 4,
             and report["timeline_causal"]
             and report["timeline_epochs"] == [1, 2]
             and report["timeline_attribution_ok"]
-            and report["incident_report_sha_match"])
+            and report["incident_report_sha_match"]
+            and report["journal_group_commit"]["enabled"]
+            and report["journal_group_commit"]["group_commit"])
         return report
     finally:
         if master.poll() is None:
@@ -2203,6 +2214,17 @@ def serve_drain(n_requests: int = 8, max_new_tokens: int = 24,
         w2.wait(timeout=10)
         from .telemetry import timeline as tl
 
+        # the serve verbs above (journaled+idem submit/lease/result)
+        # must have ridden the group-commit journal — batched fsync is
+        # the default this drill now gates on, with the frames-per-sync
+        # gauge surfaced as evidence
+        js = cli.get_journal_stats()
+        report["journal_group_commit"] = {
+            "enabled": js.enabled, "group_commit": js.group_commit,
+            "max_frames": js.max_frames,
+            "batch_mean": round(js.batch_mean, 2),
+            "durable_seq": js.durable_seq}
+
         live = cli.get_timeline(ckpt_dir=ckpt_dir)
         offline = tl.assemble_incident(journal_dir=journal_dir,
                                        ckpt_dir=ckpt_dir)
@@ -2253,7 +2275,9 @@ def serve_drain(n_requests: int = 8, max_new_tokens: int = 24,
             and report["timeline_byte_equal"]
             and report["timeline_causal"]
             and report["timeline_serve_exactly_once"]
-            and report["incident_report_sha_match"])
+            and report["incident_report_sha_match"]
+            and report["journal_group_commit"]["enabled"]
+            and report["journal_group_commit"]["group_commit"])
         return report
     finally:
         tails = {}

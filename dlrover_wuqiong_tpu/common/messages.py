@@ -303,6 +303,37 @@ class PerfSummary:
     nodes: int = 0
 
 
+@message
+class JournalStatsQuery:
+    """Pull the master's journal group-commit gauges (read-only, never
+    journaled — the fleet bench and perf_probe poll it)."""
+
+    pass
+
+
+@message
+class JournalStats:
+    """Group-commit gauges for the master journal (master/journal.py).
+
+    ``enabled`` is False on journal-less masters (standalone/test);
+    ``group_commit`` is False when max_frames=1 (the per-frame-fsync
+    baseline).  batch_mean/batch_max describe frames-per-fsync since
+    the master started — the fleet bench's amortization evidence.
+    """
+
+    enabled: bool = False
+    group_commit: bool = False
+    max_frames: int = 0
+    max_wait_ms: float = 0.0
+    fsync_floor_ms: float = 0.0
+    batches: int = 0
+    frames: int = 0
+    batch_mean: float = 0.0
+    batch_max: int = 0
+    durable_seq: int = 0
+    epoch: int = 0
+
+
 # ---------------------------------------------------------------- kv store
 
 

@@ -38,12 +38,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--policy-prior", default="",
                    help="preempt_table.json from `chaos preempt-table` to "
                         "seed the policy engine's cost model")
+    p.add_argument("--group-commit-max-frames", type=int, default=None,
+                   help="journal group-commit batch cap (1 = per-frame "
+                        "fsync; default from DWT_JOURNAL_GROUP_MAX_FRAMES "
+                        "/ DWT_JOURNAL_GROUP_COMMIT=0, else 256)")
+    p.add_argument("--group-commit-max-wait-ms", type=float, default=None,
+                   help="batch leader linger before fsync (default from "
+                        "DWT_JOURNAL_GROUP_MAX_WAIT_MS, else 0: a single "
+                        "writer pays no extra latency)")
     args = p.parse_args(argv)
     return run_master_forever(
         args.port, args.min_nodes, args.max_nodes, node_unit=args.node_unit,
         journal_dir=args.journal_dir or None,
         poll_interval=args.poll_interval, max_seconds=args.max_seconds,
-        policy=args.policy, policy_prior=args.policy_prior)
+        policy=args.policy, policy_prior=args.policy_prior,
+        group_commit_max_frames=args.group_commit_max_frames,
+        group_commit_max_wait_ms=args.group_commit_max_wait_ms)
 
 
 if __name__ == "__main__":

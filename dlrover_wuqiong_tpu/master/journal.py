@@ -28,8 +28,29 @@ crash BETWEEN
 prefix instead of double-applying (kv_store_add replayed twice would
 drift the counter).  A torn final line — the master was SIGKILLed
 mid-append — is detected by the JSON decoder and dropped with a warning;
-the event it described was never acknowledged to any client (append
-happens before the response frame), so dropping it is exactly at-most-once.
+the event it described was never acknowledged to any client (the ack
+waits on the durable watermark), so dropping it is exactly at-most-once.
+
+**Group commit** (ISSUE 18): concurrent appenders coalesce into ONE
+write + ONE fsync.  ``append_nowait`` assigns the seq and enqueues the
+encoded frame under the lock; ``wait_durable`` blocks until the durable
+watermark covers that seq.  The first waiter with a non-empty queue and
+no writer in flight elects itself the batch LEADER: it takes up to
+``group_commit_max_frames`` queued frames, writes them as one payload
+and fsyncs WITH THE LOCK RELEASED (new appenders keep enqueueing behind
+the in-flight batch), then publishes the watermark and wakes every
+follower.  Journal-before-ack is preserved PER FRAME — ``append`` is
+exactly ``wait_durable(append_nowait(...))`` — while N concurrent
+frames share one disk sync; an idem key and its response still ride one
+frame, so a torn batch tail can only drop whole (never-acked) frames,
+never tear a key/response pair.  ``group_commit_max_frames=1`` degrades
+to the historical per-frame-fsync behavior (the bench baseline), and
+``group_commit_max_wait_ms`` optionally lets the leader linger for
+followers before syncing (default 0: a single writer pays no extra
+latency).  Compaction FENCES the queue: new appends park, the pending
+batch drains durably, and only then is the log swapped — a frame can
+never land in a truncated file (tests/test_master_restart.py races
+append against compact to pin this).
 
 Layout under ``dir``:
   journal.frames   append-only event log (truncated at each compaction)
@@ -54,18 +75,77 @@ JOURNAL_FILE = "journal.frames"
 SNAPSHOT_FILE = "snapshot.frame"
 
 
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        logger.warning("journal: ignoring non-integer %s=%r", name, raw)
+        return default
+
+
+def _default_group_commit_max_frames() -> int:
+    """Env-derived default batch cap: DWT_JOURNAL_GROUP_COMMIT=0 disables
+    batching entirely (cap 1 = historical per-frame fsync), otherwise
+    DWT_JOURNAL_GROUP_MAX_FRAMES caps the batch (default 256)."""
+    if os.environ.get("DWT_JOURNAL_GROUP_COMMIT", "1") == "0":
+        return 1
+    return max(1, _env_int("DWT_JOURNAL_GROUP_MAX_FRAMES", 256))
+
+
+def _default_group_commit_max_wait_ms() -> float:
+    """Env-derived default leader linger (ms).  0 (the default) means the
+    leader syncs immediately with whatever is queued — a single writer
+    pays no added latency over the historical per-frame path."""
+    return max(0.0, float(_env_int("DWT_JOURNAL_GROUP_MAX_WAIT_MS", 0)))
+
+
+def _default_fsync_floor_ms() -> float:
+    """BENCHMARK-ONLY storage emulation: DWT_JOURNAL_FSYNC_FLOOR_MS pads
+    every commit sync to at least this many milliseconds.  Local NVMe
+    fsyncs in ~0.1ms, but the deployment this master targets journals to
+    network-attached disks (cloud PD-class: 1-5ms per sync) — the fleet
+    bench sets the floor so the per-frame-vs-grouped A/B measures the
+    production regime, and reports the floor it used.  Default 0 = off;
+    never set this on a real job."""
+    return max(0.0, float(_env_int("DWT_JOURNAL_FSYNC_FLOOR_MS", 0)))
+
+
 class MasterJournal:
     """Event log + snapshot/compaction for one master's control plane."""
 
     def __init__(self, journal_dir: str, fsync: bool = True,
-                 snapshot_every: int = 1000):
+                 snapshot_every: int = 1000,
+                 group_commit_max_frames: Optional[int] = None,
+                 group_commit_max_wait_ms: Optional[float] = None):
         self.dir = journal_dir
         os.makedirs(journal_dir, exist_ok=True)
         self._path = os.path.join(journal_dir, JOURNAL_FILE)
         self._snap_path = os.path.join(journal_dir, SNAPSHOT_FILE)
         self._fsync = fsync
         self.snapshot_every = max(1, snapshot_every)
+        if group_commit_max_frames is None:
+            group_commit_max_frames = _default_group_commit_max_frames()
+        if group_commit_max_wait_ms is None:
+            group_commit_max_wait_ms = _default_group_commit_max_wait_ms()
+        self.group_commit_max_frames = max(1, int(group_commit_max_frames))
+        self.group_commit_max_wait_ms = max(0.0,
+                                            float(group_commit_max_wait_ms))
+        self.fsync_floor_ms = _default_fsync_floor_ms()
         self._lock = threading.Lock()
+        # group-commit state: queue of (seq, encoded frame) awaiting the
+        # leader, the durable watermark acks gate on, and a fence that
+        # parks appenders while compaction swaps the log.
+        self._cond = threading.Condition(self._lock)
+        self._queue: List[Tuple[int, bytes]] = []
+        self._durable_seq = 0
+        self._writer_active = False
+        self._fenced = False
+        self._batches = 0
+        self._frames_committed = 0
+        self._batch_max = 0
         self._fh = None
         self._seq = 0
         self.epoch = 0
@@ -122,6 +202,7 @@ class MasterJournal:
                     continue  # already inside the snapshot
                 entries.append(frame)
         self._seq = max_seq
+        self._durable_seq = max_seq
         self.epoch = last_epoch
         return snapshot, entries
 
@@ -135,76 +216,218 @@ class MasterJournal:
                     self.epoch, self._seq)
         return self.epoch
 
-    def append(self, kind: str, data: Dict[str, Any]):
-        """Append one event frame; flushed (and fsynced) before return so
-        an acked RPC implies a durable record."""
-        with self._lock:
+    def append(self, kind: str, data: Dict[str, Any]) -> int:
+        """Append one event frame, DURABLE before return, so an acked RPC
+        implies a durable record.  Equivalent to
+        ``wait_durable(append_nowait(...))`` — under concurrency the frame
+        shares its fsync with every other frame in the same batch."""
+        return self.wait_durable(self.append_nowait(kind, data))
+
+    def append_nowait(self, kind: str, data: Dict[str, Any]) -> int:
+        """Assign a seq and enqueue the encoded frame for the next batch.
+
+        Returns the seq; the frame is NOT durable yet — the caller must
+        gate its ack on ``wait_durable(seq)``.  Seq assignment and
+        enqueue happen under one lock, so file order equals seq order.
+        """
+        with self._cond:
+            while self._fenced:
+                self._cond.wait(0.05)
             self._seq += 1
+            seq = self._seq
             # ts is a PERSISTED cross-process timestamp for the incident
             # timeline, never duration math — causal order stays
             # (epoch, seq)  # graftlint: disable=wall-clock-duration -- persisted cross-process timestamp (timeline interleaving), not elapsed-time math
-            frame = serialize.dumps({"seq": self._seq, "kind": kind,
+            frame = serialize.dumps({"seq": seq, "kind": kind,
                                      "ts": time.time(), "data": data})
-            try:
-                if self._fh is None:
-                    self._fh = open(self._path, "ab")
-                self._fh.write(frame + b"\n")
-                self._fh.flush()
-                if self._fsync:
-                    os.fsync(self._fh.fileno())  # graftlint: disable=blocking-under-lock -- fsync-before-ack: the lock must span write+fsync or appends lose their durable total order
-            except OSError:
-                # durability degraded, availability preserved: the master
-                # keeps serving (a full disk must not take training down)
-                logger.exception("journal append failed (kind=%s)", kind)
-                return
+            self._queue.append((seq, frame))
             if kind != "epoch":
                 self.entries_since_snapshot += 1
+            self._cond.notify_all()
+            return seq
+
+    def wait_durable(self, seq: int) -> int:
+        """Block until the durable watermark covers ``seq``; returns it.
+
+        The first waiter that finds queued frames and no writer in
+        flight elects itself the batch leader and commits up to
+        ``group_commit_max_frames`` frames with the lock RELEASED —
+        followers keep enqueueing behind the in-flight batch and are
+        woken when the watermark advances past their seq.
+        """
+        while True:
+            batch: List[Tuple[int, bytes]] = []
+            with self._cond:
+                if self._durable_seq >= seq:
+                    return seq
+                if self._queue and not (self._writer_active or self._fenced):
+                    self._writer_active = True
+                    n = self.group_commit_max_frames
+                    batch = self._queue[:n]
+                    del self._queue[:n]
+                else:
+                    self._cond.wait(0.05)
+            if batch:
+                self._commit_batch(batch)
+
+    def _commit_batch(self, batch: List[Tuple[int, bytes]]):
+        """Leader path: write+fsync the batch unlocked, then publish the
+        durable watermark and wake followers.  Caller must hold the
+        writer claim (``_writer_active``); this always releases it."""
+        if self.group_commit_max_wait_ms > 0 and \
+                len(batch) < self.group_commit_max_frames:
+            # optional linger: give followers one window to join the batch
+            with self._cond:
+                self._cond.wait(self.group_commit_max_wait_ms / 1000.0)
+                n = self.group_commit_max_frames - len(batch)
+                if n > 0 and self._queue:
+                    batch.extend(self._queue[:n])
+                    del self._queue[:n]
+        payload = b"".join(frame + b"\n" for _, frame in batch)
+        try:
+            try:
+                t0 = time.monotonic()
+                if self._fh is None:
+                    self._fh = open(self._path, "ab")
+                self._fh.write(payload)
+                self._fh.flush()
+                if self._fsync:
+                    os.fsync(self._fh.fileno())
+                if self.fsync_floor_ms > 0:
+                    # benchmark-only slow-storage emulation: pad the SYNC
+                    # (one per batch, like a real device) to the floor
+                    rem = self.fsync_floor_ms / 1000.0 - (time.monotonic()
+                                                          - t0)
+                    if rem > 0:
+                        time.sleep(rem)
+            except OSError:
+                # durability degraded, availability preserved: the master
+                # keeps serving (a full disk must not take training down).
+                # The watermark still advances — same contract as before.
+                logger.exception("journal commit failed (%d frames)",
+                                 len(batch))
+        finally:
+            # watermark + writer claim ALWAYS release, or every later
+            # append would park forever behind a dead leader
+            with self._cond:
+                self._durable_seq = max(self._durable_seq, batch[-1][0])
+                self._writer_active = False
+                self._batches += 1
+                self._frames_committed += len(batch)
+                self._batch_max = max(self._batch_max, len(batch))
+                self._cond.notify_all()
+
+    def group_commit_stats(self) -> Dict[str, Any]:
+        """ADD-ONLY stats dict for JournalStats / the fleet bench."""
+        with self._cond:
+            batches = self._batches
+            frames = self._frames_committed
+            return {
+                "group_commit": self.group_commit_max_frames > 1,
+                "max_frames": self.group_commit_max_frames,
+                "max_wait_ms": self.group_commit_max_wait_ms,
+                "fsync_floor_ms": self.fsync_floor_ms,
+                "batches": batches,
+                "frames": frames,
+                "batch_mean": (frames / batches) if batches else 0.0,
+                "batch_max": self._batch_max,
+                "durable_seq": self._durable_seq,
+            }
 
     # ------------------------------------------------------------- snapshot
 
+    # ----------------------------------------------------------- fencing
+
+    def _acquire_fence(self):
+        """Park new appenders and leader elections behind the fence."""
+        with self._cond:
+            while self._fenced:
+                self._cond.wait(0.05)
+            self._fenced = True
+            self._cond.notify_all()
+
+    def _release_fence(self):
+        with self._cond:
+            self._fenced = False
+            self._cond.notify_all()
+
+    def _drain_fenced(self):
+        """Commit every queued frame durably.  Caller holds the fence, so
+        no new frames arrive; an in-flight leader finishes first."""
+        while True:
+            batch: List[Tuple[int, bytes]] = []
+            with self._cond:
+                if self._writer_active:
+                    self._cond.wait(0.05)
+                    continue
+                if not self._queue:
+                    return
+                self._writer_active = True
+                batch = self._queue[:]
+                del self._queue[:]
+            self._commit_batch(batch)
+
     def snapshot(self, state: Dict[str, Any]):
         """Write a full-state snapshot and truncate the event log.
+
+        Group-commit interaction: the fence stops new appends and leader
+        elections, then every queued frame is drained DURABLY into the
+        old log before the swap — a frame assigned a seq can never land
+        in (or vanish with) the truncated file.
 
         Crash-safe ordering: tmp-write + rename the snapshot FIRST, then
         truncate the journal.  A crash in between replays seq-duplicated
         frames, which `load()` skips via the snapshot's seq watermark.
         """
-        with self._lock:
-            frame = serialize.dumps({"epoch": self.epoch, "seq": self._seq,
-                                     "ts": time.time(), "state": state})
-            tmp = self._snap_path + ".tmp"
-            try:
-                with open(tmp, "wb") as f:
-                    f.write(frame)
-                    f.flush()
-                    os.fsync(f.fileno())  # graftlint: disable=blocking-under-lock -- compaction must exclude appends while it swaps the log; fsync inside the lock is the crash-safe ordering
-                os.replace(tmp, self._snap_path)
+        self._acquire_fence()
+        try:
+            self._drain_fenced()
+            with self._lock:
+                frame = serialize.dumps({"epoch": self.epoch,
+                                         "seq": self._seq,
+                                         "ts": time.time(), "state": state})
+                tmp = self._snap_path + ".tmp"
+                try:
+                    with open(tmp, "wb") as f:
+                        f.write(frame)
+                        f.flush()
+                        os.fsync(f.fileno())  # graftlint: disable=blocking-under-lock -- compaction critical section: the fence already excludes appends; fsync inside the lock is the crash-safe ordering
+                    os.replace(tmp, self._snap_path)
+                    if self._fh is not None:
+                        self._fh.close()
+                        self._fh = None
+                    # fresh journal holding only the current epoch marker
+                    jtmp = self._path + ".tmp"
+                    with open(jtmp, "wb") as f:
+                        self._seq += 1
+                        self._durable_seq = self._seq
+                        f.write(serialize.dumps(
+                            {"seq": self._seq, "kind": "epoch",
+                             "ts": time.time(),
+                             "data": {"epoch": self.epoch}}) + b"\n")
+                        f.flush()
+                        os.fsync(f.fileno())  # graftlint: disable=blocking-under-lock -- same compaction critical section: the fresh journal must be durable before the swap
+                    os.replace(jtmp, self._path)
+                except OSError:
+                    logger.exception("journal compaction failed")
+                    return
+                self.entries_since_snapshot = 0
+                logger.info("journal %s: snapshot at seq=%d epoch=%d",
+                            self.dir, self._seq, self.epoch)
+        finally:
+            self._release_fence()
+
+    def close(self):
+        """Drain pending frames durably, then close the file handle."""
+        self._acquire_fence()
+        try:
+            self._drain_fenced()
+            with self._lock:
                 if self._fh is not None:
                     self._fh.close()
                     self._fh = None
-                # fresh journal holding only the current epoch marker
-                jtmp = self._path + ".tmp"
-                with open(jtmp, "wb") as f:
-                    self._seq += 1
-                    f.write(serialize.dumps(
-                        {"seq": self._seq, "kind": "epoch",
-                         "ts": time.time(),
-                         "data": {"epoch": self.epoch}}) + b"\n")
-                    f.flush()
-                    os.fsync(f.fileno())  # graftlint: disable=blocking-under-lock -- same compaction critical section: the fresh journal must be durable before the swap
-                os.replace(jtmp, self._path)
-            except OSError:
-                logger.exception("journal compaction failed")
-                return
-            self.entries_since_snapshot = 0
-            logger.info("journal %s: snapshot at seq=%d epoch=%d",
-                        self.dir, self._seq, self.epoch)
-
-    def close(self):
-        with self._lock:
-            if self._fh is not None:
-                self._fh.close()
-                self._fh = None
+        finally:
+            self._release_fence()
 
 
 class IdemCache:

@@ -44,7 +44,9 @@ class JobMaster:
                  scaler: Optional[Scaler] = None,
                  job_manager: Optional[JobManager] = None,
                  journal_dir: Optional[str] = None,
-                 policy_engine=None):
+                 policy_engine=None,
+                 group_commit_max_frames: Optional[int] = None,
+                 group_commit_max_wait_ms: Optional[float] = None):
         ctx = get_context()
         self.speed_monitor = SpeedMonitor(ctx.train_speed_record_num)
         self.job_manager = job_manager or LocalJobManager(scaler=scaler)
@@ -93,6 +95,10 @@ class JobMaster:
         self.job_manager.add_node_event_callback(_CleanupCallback())
         self.diagnosis_manager = DiagnosisManager(
             ctx.hang_detection_seconds, job_manager=self.job_manager)
+        # BUFFERED-verb telemetry rides its own lock, never the journal
+        # path: hundreds of heartbeat/goodput/perf reporters must not
+        # contend with journaled mutations (ISSUE 18 sharded hot state)
+        self._telemetry_lock = threading.Lock()
         self._custom_metrics: Dict = {}
         self._node_events: list = []
         self._goodput: Dict[int, msg.GoodputLedgerReport] = {}
@@ -125,7 +131,10 @@ class JobMaster:
         self.epoch = 1
         jd = journal_dir or os.getenv("DWT_MASTER_JOURNAL_DIR", "")
         self.journal = MasterJournal(
-            jd, snapshot_every=ctx.journal_snapshot_every) if jd else None
+            jd, snapshot_every=ctx.journal_snapshot_every,
+            group_commit_max_frames=group_commit_max_frames,
+            group_commit_max_wait_ms=group_commit_max_wait_ms,
+        ) if jd else None
         if self.journal is not None:
             self._replay_journal()
             self.epoch = self.journal.open_epoch()
@@ -329,7 +338,8 @@ class JobMaster:
             self.journal.append("paral", {"config": config})
 
     def collect_custom_data(self, payload):
-        self._custom_metrics[type(payload).__name__] = payload
+        with self._telemetry_lock:
+            self._custom_metrics[type(payload).__name__] = payload
         # CustomMetric entries named dwt_* flow into the exported registry —
         # this is how worker/agent-side timings (ckpt blocking/persist)
         # reach the master's /metrics endpoint
@@ -345,9 +355,10 @@ class JobMaster:
                         pass
 
     def record_node_event(self, event: msg.NodeEventReport):
-        self._node_events.append(event)
-        if len(self._node_events) > 1000:
-            self._node_events = self._node_events[-500:]
+        with self._telemetry_lock:
+            self._node_events.append(event)
+            if len(self._node_events) > 1000:
+                self._node_events = self._node_events[-500:]
         # node events are flight-recorder events on the master too — a
         # master-side dump carries the fault context workers reported
         from ..telemetry.recorder import get_recorder
@@ -366,11 +377,12 @@ class JobMaster:
         degraded buffer drains AFTER the frame that re-established the
         connection, so buffered (older) snapshots arrive last across a
         master restart and must not overwrite the fresh one."""
-        prev = self._goodput.get(report.node_id)
-        if prev is not None and getattr(prev, "sent_at", 0.0) > \
-                getattr(report, "sent_at", 0.0) > 0.0:
-            return
-        self._goodput[report.node_id] = report
+        with self._telemetry_lock:
+            prev = self._goodput.get(report.node_id)
+            if prev is not None and getattr(prev, "sent_at", 0.0) > \
+                    getattr(report, "sent_at", 0.0) > 0.0:
+                return
+            self._goodput[report.node_id] = report
         for state, secs in report.states.items():
             self.metric_collector.reg.gauge(
                 "dwt_goodput_seconds", float(secs),
@@ -387,7 +399,9 @@ class JobMaster:
         """Job-level aggregation: sum the latest per-node snapshots."""
         states: Dict[str, float] = {}
         wall = other = 0.0
-        for rep in self._goodput.values():
+        with self._telemetry_lock:
+            reports = list(self._goodput.values())
+        for rep in reports:
             wall += rep.wall_s
             other += rep.other_s
             for state, secs in rep.states.items():
@@ -397,7 +411,7 @@ class JobMaster:
         return msg.GoodputSummary(
             states=states, wall_s=wall, other_s=other,
             goodput_fraction=(productive / total) if total > 0 else 0.0,
-            nodes=len(self._goodput))
+            nodes=len(reports))
 
     # ---------------------------------------------------------------- perf
 
@@ -408,11 +422,12 @@ class JobMaster:
         Also the satellite feed for diagnosis: the snapshot's op-category
         split lands in DiagnosisDataManager's op-profile store, so hang
         resolution and the perf observatory read ONE source of truth."""
-        prev = self._perf.get(report.node_id)
-        if prev is not None and getattr(prev, "sent_at", 0.0) > \
-                getattr(report, "sent_at", 0.0) > 0.0:
-            return
-        self._perf[report.node_id] = report
+        with self._telemetry_lock:
+            prev = self._perf.get(report.node_id)
+            if prev is not None and getattr(prev, "sent_at", 0.0) > \
+                    getattr(report, "sent_at", 0.0) > 0.0:
+                return
+            self._perf[report.node_id] = report
         snap = report.snapshot or {}
         try:
             self.diagnosis_manager.data.store_perf_snapshot(
@@ -435,8 +450,9 @@ class JobMaster:
 
     def perf_summary(self) -> msg.PerfSummary:
         """Job-level view: latest snapshot per node + event totals."""
-        snapshots = {str(nid): dict(rep.snapshot or {})
-                     for nid, rep in self._perf.items()}
+        with self._telemetry_lock:
+            snapshots = {str(nid): dict(rep.snapshot or {})
+                         for nid, rep in self._perf.items()}
         return msg.PerfSummary(
             snapshots=snapshots,
             regressions=sum(int(s.get("regressions", 0))
@@ -444,6 +460,13 @@ class JobMaster:
             retraces=sum(int(s.get("retraces", 0))
                          for s in snapshots.values()),
             nodes=len(snapshots))
+
+    def journal_stats(self) -> msg.JournalStats:
+        """Group-commit gauges (read-only poll, never journaled)."""
+        if self.journal is None:
+            return msg.JournalStats(enabled=False, epoch=self.epoch)
+        return msg.JournalStats(enabled=True, epoch=self.epoch,
+                                **self.journal.group_commit_stats())
 
     # ------------------------------------------------------------- serving
 
@@ -642,7 +665,9 @@ def run_master_forever(port: int, min_nodes: int, max_nodes: int,
                        poll_interval: float = 5.0,
                        max_seconds: Optional[float] = None,
                        policy: bool = False,
-                       policy_prior: str = ""):
+                       policy_prior: str = "",
+                       group_commit_max_frames: Optional[int] = None,
+                       group_commit_max_wait_ms: Optional[float] = None):
     """Entry for a standalone master process (parity master/main.py:63)."""
     engine = None
     if policy:
@@ -651,7 +676,9 @@ def run_master_forever(port: int, min_nodes: int, max_nodes: int,
         engine = PolicyEngine(prior_path=policy_prior)
     master = JobMaster(port=port, min_nodes=min_nodes, max_nodes=max_nodes,
                        node_unit=node_unit, journal_dir=journal_dir,
-                       policy_engine=engine)
+                       policy_engine=engine,
+                       group_commit_max_frames=group_commit_max_frames,
+                       group_commit_max_wait_ms=group_commit_max_wait_ms)
     master.prepare()
     try:
         return master.run(poll_interval=poll_interval,

@@ -46,6 +46,10 @@ class ServeQueueManager:
         self._submitted_total = 0
         self._requeued_total = 0
         self._done_total = 0
+        # BUFFERED-verb telemetry under its OWN lock: stats ingestion
+        # (hundreds of workers, latest-wins) must never contend with the
+        # journaled submit/lease/result path on the queue lock
+        self._stats_lock = threading.Lock()
         self._stats: Dict[int, ServeStatsReport] = {}
 
     # ------------------------------------------------------------ mutations
@@ -137,7 +141,7 @@ class ServeQueueManager:
     def collect_stats(self, report: ServeStatsReport):
         """Latest-SENT-wins per worker (BUFFERED verb class drains stale
         snapshots after reconnect)."""
-        with self._lock:
+        with self._stats_lock:
             prev = self._stats.get(report.node_id)
             if prev is None or report.sent_at >= prev.sent_at:
                 self._stats[report.node_id] = report
@@ -145,8 +149,9 @@ class ServeQueueManager:
     # ------------------------------------------------------------ queries
 
     def summary(self) -> ServeSummary:
-        with self._lock:
+        with self._stats_lock:
             stats = list(self._stats.values())
+        with self._lock:
             summ = ServeSummary(
                 queue_depth=len(self._pending),
                 leased=len(self._leased),

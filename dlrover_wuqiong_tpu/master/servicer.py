@@ -55,13 +55,18 @@ class MasterServicer:
                  resp: Any = None):
         """Append one event frame; idem-keyed events carry their response
         so replay rebuilds the at-most-once cache atomically with the
-        mutation (a separate idem frame could be lost between appends)."""
+        mutation (a separate idem frame could be lost between appends).
+
+        Group commit: the frame is enqueued and the ack gates on the
+        journal's DURABLE WATERMARK covering its seq — concurrent verbs
+        share one fsync, journal-before-ack holds per frame."""
         journal = getattr(self.m, "journal", None)
         if journal is None:
             return
         if idem:
             data = {**data, "idem": idem, "resp": resp}
-        journal.append(kind, data)
+        seq = journal.append_nowait(kind, data)
+        journal.wait_durable(seq)
 
     def _get(self, node_id: int, node_type: str, payload: Any,
              idem: Optional[str] = None) -> Any:
@@ -160,6 +165,11 @@ class MasterServicer:
 
         if isinstance(payload, msg.PerfQuery):
             return m.perf_summary()
+
+        if isinstance(payload, msg.JournalStatsQuery):
+            # read-only gauge poll (never journaled): group-commit batch
+            # sizes + durable watermark for the fleet bench and perf_probe
+            return m.journal_stats()
 
         if isinstance(payload, msg.ServeLeaseRequest):
             leased = m.serve_queue.lease(payload.node_id,

@@ -115,6 +115,110 @@ class TestJournalBeforeAck:
         assert found == []
 
 
+# ------------------------------------------- group-commit batched shape
+
+
+_GC_PREAMBLE = """\
+    class Servicer:
+        def _journal(self, kind, data, idem=None, resp=None):
+            journal = self.m.journal
+            if journal is None:
+                return
+            seq = journal.append_nowait(kind, data)
+            journal.wait_durable(seq)
+
+"""
+
+
+class TestGroupCommitShape:
+    """The batched journal-before-ack shape: an ack gated on the durable
+    watermark (append_nowait + wait_durable) counts as journal-append
+    reaching the ack; an async enqueue with NO watermark gate is the new
+    bad shape (the ack would race the batch leader's fsync)."""
+
+    def test_batched_journal_helper_clean(self, tmp_path):
+        # the in-tree MasterServicer._journal shape after group commit
+        found = _scan(tmp_path, "servicer.py", _GC_PREAMBLE + """\
+        def _report(self, node_id, payload, idem=None):
+            if isinstance(payload, msg.TaskResult):
+                self.m.task_manager.report_dataset_task(
+                    node_id, payload.dataset_name, payload.task_id)
+                resp = msg.OkResponse()
+                self._journal("task_result", {"task_id": payload.task_id},
+                              idem=idem, resp=resp)
+                return resp
+            return None
+""")
+        assert found == []
+
+    def test_async_append_without_durable_wait_flagged(self, tmp_path):
+        found = _scan(tmp_path, "servicer.py", _GC_PREAMBLE + """\
+        def _enqueue_only(self, kind, data, idem=None):
+            self.m.journal.append_nowait(kind, data)
+
+        def _report(self, node_id, payload, idem=None):
+            if isinstance(payload, msg.KVStoreSetRequest):
+                self.m.kv_store.set(payload.key, payload.value)
+                self._enqueue_only("kv_set", {"key": payload.key})
+                return msg.OkResponse()
+            return None
+""")
+        assert [f.checker for f in found] == ["journal-before-ack"]
+        assert "wait_durable" in found[0].message
+
+    def test_split_shape_assembled_in_branch_clean(self, tmp_path):
+        # enqueue and watermark gate via SEPARATE helpers, paired in the
+        # branch before the ack — a legal decomposition of group commit
+        found = _scan(tmp_path, "servicer.py", _GC_PREAMBLE + """\
+        def _enqueue(self, kind, data, idem=None):
+            return self.m.journal.append_nowait(kind, data)
+
+        def _gate(self, seq):
+            self.m.journal.wait_durable(seq)
+
+        def _report(self, node_id, payload, idem=None):
+            if isinstance(payload, msg.KVStoreSetRequest):
+                self.m.kv_store.set(payload.key, payload.value)
+                seq = self._enqueue("kv_set", {"key": payload.key})
+                self._gate(seq)
+                return msg.OkResponse()
+            return None
+""")
+        assert found == []
+
+    def test_idem_key_rides_the_async_half(self, tmp_path):
+        # idem-key-required must see idem= on the enqueue call even when
+        # the durability gate is a separate helper
+        found = _scan(tmp_path, "servicer.py", _GC_PREAMBLE + """\
+        def _enqueue(self, kind, data, idem=None):
+            return self.m.journal.append_nowait(kind, data)
+
+        def _gate(self, seq):
+            self.m.journal.wait_durable(seq)
+
+        def _report(self, node_id, payload, idem=None):
+            if isinstance(payload, msg.TaskResult):
+                resp = msg.OkResponse()
+                seq = self._enqueue("task_result", {"id": payload.task_id},
+                                    idem=idem)
+                self._gate(seq)
+                return resp
+            return None
+""")
+        assert found == []
+
+    def test_idem_missing_on_batched_shape_flagged(self, tmp_path):
+        found = _scan(tmp_path, "servicer.py", _GC_PREAMBLE + """\
+        def _report(self, node_id, payload, idem=None):
+            if isinstance(payload, msg.TaskResult):
+                resp = msg.OkResponse()
+                self._journal("task_result", {"id": payload.task_id})
+                return resp
+            return None
+""")
+        assert [f.checker for f in found] == ["idem-key-required"]
+
+
 class TestPolicyVerbs:
     """PolicyDecisionReport sits in JOURNALED_VERBS + IDEM_VERBS: an
     adaptive decision that vanishes across a master restart would leave

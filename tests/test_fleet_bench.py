@@ -1,0 +1,78 @@
+"""Smoke tests for the synthetic-fleet RPC benchmark (fleet_bench.py).
+
+The full A/B (200 clients, two master phases, slow-storage floor) runs
+from bench.py / `tools/perf_probe.py rpc`; here we pin the cheap
+invariants: the module stays jax-free (spawn'd client workers re-import
+it), the percentile helper, and one tiny end-to-end fleet round against
+a real spawned master with group commit on.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+from dlrover_wuqiong_tpu.fleet_bench import (
+    VERB_CLASSES,
+    FleetMaster,
+    _percentile,
+    run_fleet,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestModuleIsLight:
+    def test_import_does_not_pull_jax(self):
+        # client worker processes re-import this module on spawn; if it
+        # ever grows a jax import, every fleet worker pays jax startup
+        # (and the CPU-only guarantee dies)
+        code = ("import sys; import dlrover_wuqiong_tpu.fleet_bench; "
+                "print(json.dumps([m for m in ('jax', 'jaxlib', 'flax') "
+                "if m in sys.modules]))")
+        out = subprocess.run(
+            [sys.executable, "-c", "import json; " + code],
+            env=dict(os.environ, PYTHONPATH=REPO_ROOT),
+            capture_output=True, text=True, timeout=60, check=True)
+        assert json.loads(out.stdout.strip().splitlines()[-1]) == []
+
+
+class TestPercentile:
+    def test_empty_is_zero(self):
+        assert _percentile([], 0.99) == 0.0
+
+    def test_singleton(self):
+        assert _percentile([7.0], 0.5) == 7.0
+        assert _percentile([7.0], 0.99) == 7.0
+
+    def test_tail_rank(self):
+        vals = [float(i) for i in range(1, 101)]
+        assert _percentile(vals, 0.50) == 50.0
+        assert _percentile(vals, 0.99) == 99.0
+        assert _percentile(vals, 1.0) == 100.0
+
+
+class TestTinyFleet:
+    def test_one_round_against_real_master(self):
+        # smallest honest fleet: 4 clients over 2 spawned procs, short
+        # window, no storage floor — pins the report contract and that
+        # the journal gauges attribute to a group-commit master
+        with FleetMaster(group_commit=True) as fm:
+            report = run_fleet(fm.addr, clients=4, procs=2,
+                               duration_s=0.8)
+            js = fm.journal_stats()
+        assert report["clients"] == 4 and report["procs"] == 2
+        for cls in VERB_CLASSES:
+            assert set(report[cls]) == {"count", "rpc_per_s", "p50_ms",
+                                        "p99_ms"}
+        assert report["rpc_total"] == sum(
+            report[c]["count"] for c in VERB_CLASSES)
+        assert report["rpc_total"] > 0
+        assert report["journaled"]["count"] > 0  # kv_set/kv_add landed
+        assert report["rpc_p99_ms"] > 0.0
+        assert report["rpc_errors"] == 0
+        assert js["enabled"] and js["group_commit"]
+        assert js["max_frames"] == 256
+        assert js["fsync_floor_ms"] == 0.0
+        assert js["frames"] >= report["journaled"]["count"]
+        assert js["durable_seq"] >= js["frames"]

@@ -6,8 +6,8 @@ the bench.py rules (host readback; chain iterations on carried values —
 `block_until_ready` is a no-op over the tunnel).
 
 Usage: python tools/perf_probe.py [attn|attn_sweep|head|model|opt|step|lib|
-dispatch|fa-variants|quant-variants] ...  (no args = step/attn/head/model/
-opt).  One JSON line per probe as it finishes, then ONE summary line
+dispatch|fa-variants|quant-variants|rpc] ...  (no args = step/attn/head/
+model/opt).  One JSON line per probe as it finishes, then ONE summary line
 ``{"probes": [...], "emitted": N}`` under the shared report-CLI contract
 (common/report_cli.py; -h to stderr rc=0, unknown probe rc=1).
 `dispatch` measures the fused-vs-unfused dispatch-overhead win of
@@ -16,6 +16,8 @@ the K-step driver (trainer/train_step.py) in THIS environment;
 interleaved (same-session, chip drift) via the tuner's scorer;
 `quant-variants` races the dense-matmul precision ladder (f32/bf16
 vs the fp8 kernel the tuner's quant axis swaps in) the same way.
+`rpc` streams per-round control-plane RPCs/s per verb class against a
+per-frame-fsync and a group-commit master, rounds interleaved.
 """
 
 from __future__ import annotations
@@ -614,13 +616,62 @@ def probe_remat():
                        "error": repr(e)[:200]})
 
 
+def probe_rpc(rounds=2, clients=48, procs=4, duration_s=1.5,
+              fsync_floor_ms=3.0):
+    """Control-plane RPC throughput per verb class, streamed per round.
+
+    Two masters stay up for the whole probe — per-frame-fsync baseline
+    and group-commit — and rounds ALTERNATE between them (the same
+    same-session interleave rule as the kernel A/B probes: host load
+    drifts ±10% run to run, so paired rounds beat sequential blocks).
+    Each round prints one line with journaled/buffered/polling RPCs/s,
+    the aggregate p99 and the journal's frames-per-fsync gauge; the
+    last line summarizes the journaled-verb speedup over the paired
+    baseline rounds.  CPU-only (fleet_bench machinery — no accelerator
+    anywhere); ``fsync_floor_ms`` emulates PD-class journal storage,
+    pass 0 via DWT_RPC_PROBE_FSYNC_FLOOR_MS to measure bare local
+    fsync."""
+    from dlrover_wuqiong_tpu.fleet_bench import FleetMaster, run_fleet
+
+    floor = float(os.environ.get("DWT_RPC_PROBE_FSYNC_FLOOR_MS",
+                                 fsync_floor_ms))
+    rates = {"perframe": [], "grouped": []}
+    with FleetMaster(group_commit=False, fsync_floor_ms=floor) as base, \
+            FleetMaster(group_commit=True, fsync_floor_ms=floor) as gc:
+        for r in range(rounds):
+            for mode, fm in (("perframe", base), ("grouped", gc)):
+                got = run_fleet(fm.addr, clients=clients, procs=procs,
+                                duration_s=duration_s)
+                js = fm.journal_stats()
+                rates[mode].append(got["journaled"]["rpc_per_s"])
+                _emit_raw({
+                    "probe": "rpc", "mode": mode, "round": r,
+                    "clients": got["clients"],
+                    "journaled_rpc_per_s": got["journaled"]["rpc_per_s"],
+                    "buffered_rpc_per_s": got["buffered"]["rpc_per_s"],
+                    "polling_rpc_per_s": got["polling"]["rpc_per_s"],
+                    "rpc_per_s": got["rpc_per_s"],
+                    "rpc_p99_ms": got["rpc_p99_ms"],
+                    "rpc_errors": got["rpc_errors"],
+                    "journal_batch_mean": js["batch_mean"],
+                    "fsync_floor_ms": js["fsync_floor_ms"]})
+    base_mean = sum(rates["perframe"]) / max(1, len(rates["perframe"]))
+    gc_mean = sum(rates["grouped"]) / max(1, len(rates["grouped"]))
+    _emit_raw({"probe": "rpc", "summary": True, "rounds": rounds,
+               "journaled_rpc_per_s_perframe": round(base_mean, 1),
+               "journaled_rpc_per_s_grouped": round(gc_mean, 1),
+               "journaled_speedup":
+                   round(gc_mean / base_mean, 2) if base_mean else 0.0})
+
+
 ALL = {"attn": probe_attn, "attn_sweep": probe_attn_sweep, "lib": probe_lib,
        "remat": probe_remat,
        "splash": probe_splash, "dots": probe_dots,
        "head": probe_head, "model": probe_model, "opt": probe_opt,
        "step": probe_step, "dispatch": probe_dispatch,
        "fa-variants": probe_fa_variants,
-       "quant-variants": probe_quant_variants}
+       "quant-variants": probe_quant_variants,
+       "rpc": probe_rpc}
 
 
 def main(argv=None) -> int:
