@@ -213,7 +213,11 @@ class ElasticAgent:
             logger.warning("replica peer rendezvous incomplete: %s", e)
         self._replica_manager = CkptReplicaManager(
             rank=outcome.process_id, peers=peers, job_name=job,
-            replica_count=replicas)
+            replica_count=replicas,
+            # holder corruption must reach the master's event stream —
+            # the agent is the process that owns the mc here
+            health_hook=lambda reason: self.mc.report_node_event(
+                "ckpt-health", f"replica: {reason}", level="warning"))
         if not self._replica_manager.has_local_segment():
             # replacement node (or first boot after a node swap): the staged
             # checkpoint exists only on a peer — pull it into local shm so

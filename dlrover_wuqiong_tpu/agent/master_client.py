@@ -418,6 +418,29 @@ class MasterClient:
             "get", msg.PolicyHistoryRequest(node_id=self.node_id))
         return json.loads(resp.content) if resp.content else []
 
+    # ---------------------------------------------------- hot-swap re-mesh
+
+    def get_mesh_transition(self) -> msg.MeshTransitionState:
+        """Current hot-swap transition (tid 0 = none active).  POLLING
+        class on the trainer's fusion-boundary cadence — fail fast, the
+        next boundary retries."""
+        return self._call_polling(
+            "get", msg.MeshTransitionQuery(node_id=self.node_id))
+
+    def report_mesh_transition_phase(self, transition_id: int, phase: str,
+                                     ok: bool = True, detail: str = ""
+                                     ) -> msg.OkResponse:
+        """Ack one phase of the transition ladder — CRITICAL + idem: the
+        master journals the ack before answering, and a retry crossing a
+        master restart replays the recorded response instead of
+        double-acking (acks advance the fenced state machine)."""
+        return self._call_critical(
+            "report",
+            msg.MeshTransitionPhaseReport(
+                node_id=self.node_id, transition_id=transition_id,
+                phase=phase, ok=ok, detail=detail),
+            idem=self._next_idem())
+
     # ---------------------------------------------------- incident timeline
 
     def get_timeline(self, ckpt_dir: str = "") -> msg.TimelineResponse:

@@ -74,6 +74,7 @@ JOURNALED_VERBS = {
     "TaskResult", "DatasetShardParams", "NodeMeta", "NodeFailure",
     "KVStoreSetRequest", "ShardCheckpoint", "PolicyDecisionReport",
     "ServeSubmitRequest", "ServeLeaseRequest", "ServeResultReport",
+    "MeshTransitionPhaseReport",
 }
 
 #: verbs that are NOT naturally idempotent across a master restart: the
@@ -82,6 +83,7 @@ IDEM_VERBS = {
     "TaskRequest", "KVStoreAddRequest", "JoinRendezvousRequest",
     "TaskResult", "PolicyDecisionReport",
     "ServeSubmitRequest", "ServeLeaseRequest", "ServeResultReport",
+    "MeshTransitionPhaseReport",
 }
 
 #: names whose (transitive) call means "a manifest was published".

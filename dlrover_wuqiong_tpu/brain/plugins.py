@@ -357,8 +357,21 @@ def _replica_count(samples, fleet_samples, cfg) -> int:
 def _recovery_route(samples, fleet_samples, cfg):
     """→ (route, preferred restore tier).  Keep the warm pool hot while
     failures are frequent; prefer the replica tier once the ring exists
-    (shm dies with the node, storage is transfer-bound — PHOENIX)."""
-    route = "warm" if cfg["mtbf_s"] < cfg["warm_mtbf_s"] else "cold"
+    (shm dies with the node, storage is transfer-bound — PHOENIX).
+
+    "hotswap" tops the ladder: with a replica ring holding every rank's
+    shards in PEER memory, survivors can absorb a dead rank in place
+    (master/mesh_transition.py) instead of restart-the-world — worth it
+    exactly when failures are frequent enough that the warm pool is kept
+    hot anyway (the degraded-mesh executable is pre-compiled, so the
+    swap pays only the fenced hydrate, never a cold compile)."""
+    if cfg.get("replica_count", 1) >= 2 and \
+            cfg["mtbf_s"] < cfg["warm_mtbf_s"]:
+        route = "hotswap"
+    elif cfg["mtbf_s"] < cfg["warm_mtbf_s"]:
+        route = "warm"
+    else:
+        route = "cold"
     tier = "replica" if (cfg.get("replica_count", 1) >= 2
                          and cfg["mtbf_s"] < cfg["replica_mtbf_s"]) \
         else "shm"

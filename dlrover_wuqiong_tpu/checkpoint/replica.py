@@ -17,10 +17,13 @@ cheap (goodput comes from restore speed, SURVEY.md §7 hard-part (a)).
 from __future__ import annotations
 
 import json
+import os
 import socket
 import socketserver
 import struct
+import tempfile
 import threading
+import time
 from typing import Dict, Optional, Tuple
 
 from ..common.log import get_logger
@@ -141,7 +144,8 @@ class CkptReplicaManager:
     def __init__(self, rank: int, peers: Dict[int, str],
                  job_name: str = "dwt", local_rank: int = 0,
                  replica_count: int = 1, timeout: float = 120.0,
-                 lock_timeout: float = 2.0):
+                 lock_timeout: float = 2.0, health_hook=None,
+                 quarantine_dir: str = ""):
         """peers: rank → "host:port" of every node's ReplicaServer.
 
         `timeout` bounds peer TRANSFERS (big blobs over DCN);
@@ -149,15 +153,25 @@ class CkptReplicaManager:
         missing lock server (no saver running: standalone replica use,
         tests) must cost seconds, not the full transfer budget, or every
         backup() waits out a 150s dial to a unix socket that will never
-        exist."""
+        exist.
+
+        `health_hook(reason)` is called on every verification failure of
+        a pulled blob (the agent wires it to a ckpt-health node event —
+        corruption in a holder's store must be REPORTED, never silently
+        absorbed); `quarantine_dir` overrides where corrupt-blob evidence
+        is kept (defaults to a job-scoped tempdir sidecar)."""
         from ..common.multi_process import SharedLock
         from .ckpt_saver import shm_lock_name
 
         self.rank = rank
         self.peers = dict(peers)
+        self.job_name = job_name
         self.replica_count = max(0, replica_count)
         self.timeout = timeout
         self.lock_timeout = lock_timeout
+        self.health_hook = health_hook
+        self.quarantine_dir = quarantine_dir or os.path.join(
+            tempfile.gettempdir(), f"dwt-{job_name}-replica.quarantine")
         self._shm = SharedMemoryHandler(local_rank, job_name)
         # same lock the saver/engine use: a concurrent drain restaging the
         # segment must not tear the copy we ship
@@ -200,22 +214,42 @@ class CkptReplicaManager:
                 except Exception:  # noqa: BLE001
                     pass
 
-    def _successors(self, count: Optional[int] = None):
-        """Ring members after me, nearest first (up to `count`)."""
-        ranks = sorted(self.peers)
-        if self.rank not in ranks:
-            ranks.append(self.rank)
-            ranks.sort()
-        idx = ranks.index(self.rank)
-        limit = count if count is not None else self.replica_count
+    def _successors_of(self, owner: int,
+                       count: Optional[int] = None):
+        """Ring members after `owner`, nearest first, deduped by ADDRESS.
+
+        One ReplicaServer runs per AGENT, so with several ranks per node
+        (or replica_count >= len(peers)) a naive rank walk revisits the
+        same server — worst case it ships a segment to its own creator's
+        node, a "backup" that dies with it.  The walk therefore skips any
+        rank whose server address equals the owner's own, and visits each
+        distinct address at most once.  `count=None` walks the whole ring.
+        """
+        ranks = sorted(set(self.peers) | {owner})
+        idx = ranks.index(owner)
+        own_addr = self.peers.get(owner)
+        seen_addrs = {own_addr} if own_addr else set()
         out = []
         for k in range(1, len(ranks)):
             peer = ranks[(idx + k) % len(ranks)]
-            if peer != self.rank:
-                out.append(peer)
-            if len(out) >= limit:
+            if peer == owner:
+                continue
+            addr = self.peers.get(peer)
+            if addr:
+                if addr in seen_addrs:
+                    continue
+                seen_addrs.add(addr)
+            out.append(peer)
+            if count is not None and len(out) >= count:
                 break
         return out
+
+    def _successors(self, count: Optional[int] = None):
+        """My ring successors, nearest first (up to `count`)."""
+        limit = self.replica_count if count is None else count
+        if limit <= 0:
+            return []
+        return self._successors_of(self.rank, limit)
 
     def backup(self) -> int:
         """Ship the staged segment to ring successor(s); returns #copies.
@@ -261,37 +295,127 @@ class CkptReplicaManager:
     def restore(self) -> Optional[int]:
         """Pull my segment from a backup holder into local shm.
 
-        Every pulled blob is digest-verified (header crc + per-leaf
-        digests, shm_handler.verify_segment_blob) BEFORE it overwrites
-        the local segment — a peer holding corrupt bytes (bit flip in
-        its store, torn transfer) is skipped and the next holder tried,
-        so the replica tier can never clobber local state with garbage.
+        Holders are walked in RING-SUCCESSOR order (where backup() put
+        the copies, nearest first) with per-holder failover: a dead
+        holder (connection refused after retries) skips to the next ring
+        successor, and a holder serving corrupt bytes is QUARANTINED as
+        evidence + reported as a ckpt-health event before the walk moves
+        on — a partial ring never fails the whole replica tier.  Every
+        pulled blob is digest-verified (header crc + per-leaf digests,
+        shm_handler.verify_segment_blob) BEFORE it overwrites the local
+        segment, so the replica tier can never clobber local state with
+        garbage.
 
         Returns the restored step, or None when no peer holds a valid
         backup.  Parity: ShardCkptReplicaManager.gather (replica.py:191).
         """
-        for peer, addr in sorted(self.peers.items()):
-            if peer == self.rank:
+        for holder in self._successors_of(self.rank):
+            payload = self._pull_verified(holder, self.rank)
+            if payload is None:
                 continue
-            try:
-                header, payload = self._rpc(addr, {"op": "get",
-                                                   "owner": self.rank})
-            except OSError:
-                continue
-            if not header.get("found") or not payload:
-                continue
-            step, why = verify_segment_blob(payload)
-            if step is None:
-                logger.error("replica from rank %d fails verification "
-                             "(%s) — trying next holder", peer, why)
-                continue
-            self._shm._ensure_size(len(payload))  # noqa: SLF001
-            self._shm._buf.buf[:len(payload)] = payload  # noqa: SLF001
+            step, blob = payload
+            self._shm._ensure_size(len(blob))  # noqa: SLF001
+            self._shm._buf.buf[:len(blob)] = blob  # noqa: SLF001
             logger.info("restored staged checkpoint step %d from rank %d "
-                        "(%.1f MB, verified, no storage read)", step, peer,
-                        len(payload) / 1e6)
+                        "(%.1f MB, verified, no storage read)", step,
+                        holder, len(blob) / 1e6)
             return step
         return None
+
+    def fetch_peer(self, owner: int) -> Optional[Tuple[int, bytes]]:
+        """Verified copy of ANOTHER rank's staged segment, no shm touch.
+
+        The hot-swap hydration path (master mesh_transition): a survivor
+        pulls the DEAD rank's segment from its ring holders so the
+        degraded mesh can absorb the lost shards from peer memory instead
+        of storage.  Holders are queried first (cheap step probe) and
+        tried newest-step first — after a partial backup round the
+        freshest copy wins; dead/corrupt holders fail over exactly like
+        restore().  Returns (step, blob) digest-verified, never bytes
+        that failed verification.
+        """
+        candidates = []
+        for holder in self._successors_of(owner):
+            addr = self.peers.get(holder)
+            if not addr:
+                continue
+            try:
+                resp, _ = self._rpc(addr, {"op": "query", "owner": owner})
+            except OSError:
+                continue
+            if resp.get("found"):
+                candidates.append((int(resp.get("step", -1)), holder))
+        for _, holder in sorted(candidates, reverse=True):
+            payload = self._pull_verified(holder, owner)
+            if payload is not None:
+                return payload
+        return None
+
+    def _pull_verified(self, holder: int,
+                       owner: int) -> Optional[Tuple[int, bytes]]:
+        """One holder attempt: get + digest-verify, evidence on failure."""
+        addr = self.peers.get(holder)
+        if not addr:
+            return None
+        try:
+            header, blob = self._rpc(addr, {"op": "get", "owner": owner})
+        except OSError as e:
+            logger.warning("replica holder rank %d (%s) unreachable (%s) "
+                           "— failing over to next ring successor",
+                           holder, addr, e)
+            return None
+        if not header.get("found") or not blob:
+            return None
+        step, why = verify_segment_blob(blob)
+        if step is None:
+            self._note_corrupt_holder(holder, owner, blob, why)
+            return None
+        return step, blob
+
+    def _note_corrupt_holder(self, holder: int, owner: int, blob: bytes,
+                             why: str):
+        """Corrupt bytes in a holder's store: evidence + report, then skip.
+
+        Mirrors the storage tier's quarantine discipline
+        (integrity.quarantine_step): the bytes are kept, never deleted,
+        and the failure surfaces as a ckpt-health event + the
+        dwt_ckpt_integrity_events metric — a bit flip inside one node's
+        replica store must be operator-visible, not a silent failover.
+        """
+        logger.error("replica of rank %d from holder rank %d fails "
+                     "verification (%s) — quarantining + trying next "
+                     "holder", owner, holder, why)
+        try:
+            os.makedirs(self.quarantine_dir, exist_ok=True)
+            base = os.path.join(self.quarantine_dir,
+                                f"owner{owner}-holder{holder}")
+            n = 0
+            while os.path.exists(f"{base}.{n}.blob"):
+                n += 1
+            with open(f"{base}.{n}.blob", "wb") as f:
+                f.write(blob)
+            with open(f"{base}.{n}.reason", "w") as f:
+                json.dump({"reason": why, "holder": holder,
+                           "owner": owner,
+                           # persisted cross-process timestamp (not a
+                           # duration) — wall clock is the right clock
+                           "time": time.time()}, f)
+        except OSError:
+            logger.exception("could not quarantine corrupt replica blob")
+        try:
+            from ..master.metrics import get_registry
+
+            get_registry().inc(
+                "dwt_ckpt_integrity_events",
+                labels={"job": self.job_name, "tier": "replica"},
+                help="checkpoint verification failures/degraded restores")
+        except Exception:  # noqa: BLE001 — metrics never break a restore
+            pass
+        if self.health_hook is not None:
+            try:
+                self.health_hook(f"holder rank {holder}: {why}")
+            except Exception:  # noqa: BLE001 — reporting never breaks it
+                logger.exception("replica health hook failed")
 
     def _rpc(self, addr: str, header: Dict,
              payload: bytes = b"") -> Tuple[Dict, bytes]:

@@ -374,6 +374,30 @@ def verify_segment_blob(blob: bytes) -> Tuple[Optional[int], str]:
     return header.get("step", 0), ""
 
 
+def blob_state_dict(blob: bytes) -> Optional[Tuple[int,
+                                                   Dict[str, np.ndarray],
+                                                   Dict]]:
+    """Parse a segment blob into (step, {name: np.ndarray}, extra).
+
+    For the hot-swap hydration path: a survivor holds a DEAD rank's
+    segment as wire bytes (replica.fetch_peer) and needs its arrays
+    without routing them through the local shm segment (which holds the
+    survivor's OWN shards).  Callers must verify first
+    (verify_segment_blob) — this helper only decodes; the sanctioned
+    route keeps digest verification between the socket and device_put.
+    """
+    header = _parse_header(blob)
+    if header is None:
+        return None
+    out: Dict[str, np.ndarray] = {}
+    for m in header.get("metas", []):
+        meta = TensorMeta.from_dict(m)
+        raw = np.frombuffer(blob[meta.offset:meta.offset + meta.nbytes],
+                            dtype=_np_dtype(meta.dtype))
+        out[meta.name] = raw.reshape(meta.shape)
+    return header.get("step", 0), out, header.get("extra", {})
+
+
 # ------------------------------------------------- stale-segment sweeper
 
 

@@ -667,3 +667,57 @@ class TimelineResponse:
 
     content: str = ""
     events: int = 0
+
+
+# --------------------------------------------------------- mesh transition
+
+
+@message
+class MeshTransitionQuery:
+    """Client → master: poll the active hot-swap mesh transition
+    (POLLING class, read-only — never journaled).  Survivors drive their
+    phase work off this state at FUSION BOUNDARIES only.  ADD-ONLY
+    family, pinned by tests/test_mesh_transition.py."""
+
+    node_id: int = -1
+
+
+@message
+class MeshTransitionState:
+    """The journaled mesh_transition state machine, as clients see it.
+
+    ``transition_id`` 0 is the no-transition sentinel.  ``phase`` walks
+    propose → fence → hydrate → cutover → release → done (or aborted);
+    every advance is a journal frame BEFORE it becomes visible here, so
+    a master crash mid-transition replays to the same phase.
+    ``fence_epoch`` is the bumped rendezvous round the post-cutover
+    world carries — survivors adopt it at the fence phase and the
+    rendezvous holds formation until release, so a replacement node
+    joining mid-transition can never race the fenced cutover.
+    ``started_at`` is a persisted cross-process timestamp (wall clock).
+    """
+
+    transition_id: int = 0
+    phase: str = ""
+    dead_node_id: int = -1
+    dead_rank: int = -1
+    survivors: List[int] = field(default_factory=list)
+    rdzv_round: int = -1   # round of the world being transitioned FROM
+    fence_epoch: int = 0   # bumped round the post-cutover world carries
+    started_at: float = 0.0
+    reason: str = ""
+
+
+@message
+class MeshTransitionPhaseReport:
+    """Survivor → master: this node finished ``phase``'s worker-side
+    work (journaled + idem — phase acks advance the fenced state
+    machine, so a retry crossing a master restart must replay the
+    recorded ack, never double-count).  ``ok=False`` aborts the
+    transition (the job falls back to the classic restart route)."""
+
+    node_id: int = -1
+    transition_id: int = 0
+    phase: str = ""
+    ok: bool = True
+    detail: str = ""

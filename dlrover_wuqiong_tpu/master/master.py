@@ -75,6 +75,14 @@ class JobMaster:
         from .serve_queue import ServeQueueManager
 
         self.serve_queue = ServeQueueManager()
+        # hot-swap re-mesh state machine (master/mesh_transition.py):
+        # constructed BEFORE the journal so replayed "mesh_transition"
+        # frames fold into it
+        from .mesh_transition import MeshTransitionManager
+
+        self.mesh = MeshTransitionManager(
+            timeout_s=float(os.getenv("DWT_MESH_TRANSITION_TIMEOUT_S",
+                                      "120")))
         # uniform failure cleanup regardless of which monitor detected it
         # (watcher event, heartbeat sweep, or explicit failure report) —
         # parity: reference event_callback.py wiring at dist_master.py:195
@@ -140,6 +148,7 @@ class JobMaster:
             self.epoch = self.journal.open_epoch()
             for name, rdzv in self.rdzv_managers.items():
                 rdzv.on_world_formed = self._journal_world
+            self._mesh_resume_after_replay()
         self._server = create_master_service(self, port=port)
         self._exit_code = 0
         self._exit_reason = ""
@@ -235,6 +244,8 @@ class JobMaster:
             self._apply_policy(decision)
         if state.get("serve"):
             self.serve_queue.restore_state(state["serve"])
+        if state.get("mesh"):
+            self.mesh.restore_state(state["mesh"])
 
     def _apply_entry(self, kind: str, data: Dict):
         data = dict(data)
@@ -298,6 +309,8 @@ class JobMaster:
                                          data["request_ids"])
         elif kind == "serve_result":
             self.serve_queue.complete(data["results"])
+        elif kind == "mesh_transition":
+            self.mesh.apply(data)
         else:
             logger.warning("journal replay: unknown frame kind %r", kind)
         if idem:
@@ -317,6 +330,7 @@ class JobMaster:
             "idem": self.idem_cache.export_state(),
             "policy": list(self._policy_decisions),
             "serve": self.serve_queue.export_state(),
+            "mesh": self.mesh.export_state(),
         }
 
     def snapshot_journal(self):
@@ -547,6 +561,117 @@ class JobMaster:
             except Exception:  # noqa: BLE001 — telemetry must never kill
                 logger.exception("policy failure-event record failed")
 
+    # ------------------------------------------------------ hot-swap re-mesh
+
+    def _journal_mesh(self, event: Dict):
+        """Master-originated mesh frames: blocking durable append —
+        the event must be on disk BEFORE apply() makes it visible."""
+        if self.journal is not None:
+            self.journal.append("mesh_transition", event)
+
+    def maybe_start_hotswap(self, node_id: int, reason: str = "") -> bool:
+        """Propose an in-place transition for a dead world member.
+
+        Fires from the failure paths (NodeFailure verb, heartbeat sweep)
+        when the adaptive policy's recovery route says "hotswap" — the
+        survivors then absorb the dead rank's shards from ring replicas
+        instead of a restart-the-world relaunch.  Returns True when a
+        transition was proposed (the caller still journals its normal
+        "recover" cleanup — task re-dispatch is wanted either way)."""
+        if self.policy_current().recovery_route != "hotswap":
+            return False
+        rdzv = self.rdzv_managers[RendezvousName.ELASTIC_TRAINING]
+        state = rdzv.export_state()
+        dead_rank, survivors = -1, []
+        for rank_s, spec in (state.get("world") or {}).items():
+            if int(spec[0]) == node_id:
+                dead_rank = int(rank_s)
+            else:
+                survivors.append(int(spec[0]))
+        if dead_rank < 0 or not survivors:
+            return False
+        event = self.mesh.propose_event(
+            node_id, dead_rank, survivors, int(state.get("round", 0)),
+            reason=reason or f"node {node_id} failed")
+        if event is None:
+            return False
+        # fence FIRST: a replacement joining between propose and hold
+        # could otherwise form a competing world under the survivors
+        rdzv.hold_formation(
+            f"mesh transition {event['tid']}: hot-swap of node {node_id}")
+        try:
+            self._journal_mesh(event)
+        except Exception:
+            rdzv.release_formation()
+            raise
+        self.mesh.apply(event)
+        logger.info(
+            "hot-swap transition %d proposed: dead node %d (rank %d), "
+            "survivors %s, fence epoch %d", event["tid"], node_id,
+            dead_rank, event["survivors"], event["fence_epoch"])
+        return True
+
+    def mesh_maybe_advance(self):
+        """Walk the phase ladder as far as acks allow — each advance is
+        its own journal frame (journal-before-visible)."""
+        rdzv = self.rdzv_managers[RendezvousName.ELASTIC_TRAINING]
+        for _ in range(8):  # bounded: ≤6 frames propose→done
+            t = self.mesh.active()
+            if t is None:
+                return
+            if t["phase"] == "release":
+                # master-side release work: rewrite the world WITHOUT the
+                # dead node (journals its own rdzv_world frame; the round
+                # bump IS the fence epoch survivors adopted).  Idempotent
+                # across replay — a re-run evict is a no-op.
+                rdzv.evict_from_world(t["dead_node_id"])
+            event = self.mesh.advance_event()
+            if event is None:
+                return
+            self._journal_mesh(event)
+            self.mesh.apply(event)
+            if event.get("event") == "abort" or \
+                    event.get("phase") in ("done", "aborted"):
+                rdzv.release_formation()
+                logger.info("mesh transition %d finished: %s",
+                            event["tid"],
+                            event.get("phase") or "aborted (%s)"
+                            % event.get("reason", ""))
+                return
+
+    def _mesh_resume_after_replay(self):
+        """Replayed mid-transition: re-arm the fence, finish release."""
+        t = self.mesh.active()
+        if t is None:
+            return
+        rdzv = self.rdzv_managers[RendezvousName.ELASTIC_TRAINING]
+        rdzv.hold_formation(
+            f"mesh transition {t['tid']} replayed at phase {t['phase']}")
+        logger.info("mesh transition %d resumed at phase %s after "
+                    "journal replay", t["tid"], t["phase"])
+        # survivors' acks are in the journal too — if the crash landed
+        # between the last ack and its phase frame, advance now; a
+        # replayed "release" also re-runs the world rewrite
+        self.mesh_maybe_advance()
+
+    def _mesh_tick(self):
+        """Abort a wedged transition (survivor died mid-ladder) so the
+        fleet falls back to classic restart-the-world recovery."""
+        if not self.mesh.timed_out():
+            return
+        event = self.mesh.abort_event("transition timeout")
+        if event is None:
+            return
+        try:
+            self._journal_mesh(event)
+        except Exception:  # noqa: BLE001 — abort must not kill the loop
+            logger.exception("mesh abort journal failed")
+        self.mesh.apply(event)
+        self.rdzv_managers[
+            RendezvousName.ELASTIC_TRAINING].release_formation()
+        logger.warning("mesh transition %d aborted: timeout — falling "
+                       "back to restart-the-world", event["tid"])
+
     def _policy_tick(self):
         """One closed-loop evaluation: journal BEFORE visibility."""
         eng = self.policy_engine
@@ -598,6 +723,7 @@ class JobMaster:
         while not self._stopped.wait(poll_interval):
             self._collect_metrics()
             self._policy_tick()
+            self._mesh_tick()
             if self.journal is not None and \
                     self.journal.entries_since_snapshot >= \
                     self.journal.snapshot_every:
@@ -623,6 +749,12 @@ class JobMaster:
                 for rdzv in self.rdzv_managers.values():
                     rdzv.remove_alive_node(node.id)
                 self.speed_monitor.remove_running_worker(node.id)
+                try:
+                    self.maybe_start_hotswap(
+                        node.id, reason="heartbeat timeout")
+                except Exception:  # noqa: BLE001 — recovery fallback is
+                    # restart-the-world; a failed propose must not wedge it
+                    logger.exception("hot-swap propose failed")
             if self.job_manager.all_workers_exited():
                 if self.job_manager.all_workers_succeeded():
                     self._exit_reason = JobExitReason.SUCCEEDED

@@ -1,0 +1,248 @@
+"""Fenced in-place mesh transition: hot-swap survivor takeover.
+
+Parity axis: the reference (`dlrover/python/master/node/job_manager.py`
+relaunch paths) only knows restart-the-world recovery; ElasWave and
+PHOENIX (PAPERS.md) argue the survivors should absorb a dead node's
+shards from peer memory instead — no teardown, no storage round trip.
+This module is the master-side state machine for that protocol:
+
+    propose → fence → hydrate → cutover → release → done
+                                    ↘ aborted (any nack / timeout)
+
+Phase ladder (worker-side work in trainer/hotswap.py):
+
+- **propose**: the policy route said "hotswap" for a dead node; the
+  master freezes the transition facts (dead rank, survivors, the fenced
+  target round) and HOLDS rendezvous formation — a replacement node
+  arriving mid-transition parks in the waiting set and cannot race the
+  cutover.  Survivors ack once paused at a FUSION BOUNDARY.
+- **fence**: survivors adopt the bumped fencing epoch (the round the
+  post-cutover world will carry); acks mean no survivor will dispatch
+  into the old world again.
+- **hydrate**: survivors pull the dead rank's staged shards from its
+  ring-replica holders (checkpoint/replica.py fetch_peer —
+  digest-verified before any byte reaches device_put).
+- **cutover**: survivors re-shard onto the pre-compiled degraded-mesh
+  executable (warm pool / persistent compile cache — zero cold
+  compiles) and confirm.
+- **release**: master rewrites the rendezvous world WITHOUT the dead
+  node (journaled rdzv_world frame, round bumped to the fenced epoch),
+  releases the formation hold, and the transition is done.
+
+Durability contract (mirrors brain/policy.py): every event — the
+propose, each survivor ack, each phase advance, an abort — is a
+``mesh_transition`` journal frame appended BEFORE the new state becomes
+visible, so a master SIGKILLed mid-transition replays to exactly the
+same phase and the survivors' next poll continues the ladder where it
+stopped.  ``apply()`` is therefore a pure state fold shared by the live
+path and journal replay; the live path journals first, replay calls
+``apply`` alone.  Phase ADVANCEMENT is decided only by the live master
+(``advance_event`` after each ack) and journaled as its own frame —
+replaying acks never re-advances, the phase frames are authoritative.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..common import messages as msg
+from ..common.log import get_logger
+
+logger = get_logger("mesh_transition")
+
+PHASES = ("propose", "fence", "hydrate", "cutover", "release")
+TERMINAL = ("done", "aborted")
+
+
+class MeshTransitionManager:
+    """State machine + event log fold for one transition at a time."""
+
+    def __init__(self, timeout_s: float = 120.0):
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._active: Optional[Dict] = None
+        self._history: List[Dict] = []
+        self.timeout_s = float(timeout_s)
+        # monotonic deadline for the ACTIVE transition (live master only
+        # — never journaled: a replayed master re-arms a fresh deadline)
+        self._deadline = 0.0
+
+    # ---------------------------------------------------------------- reads
+
+    def active(self) -> Optional[Dict]:
+        with self._lock:
+            if self._active is None or \
+                    self._active["phase"] in TERMINAL:
+                return None
+            return dict(self._active)
+
+    def state_message(self) -> msg.MeshTransitionState:
+        """Current (or last terminal) transition as the wire message."""
+        with self._lock:
+            t = self._active or (self._history[-1] if self._history
+                                 else None)
+            if t is None:
+                return msg.MeshTransitionState()
+            return msg.MeshTransitionState(
+                transition_id=t["tid"], phase=t["phase"],
+                dead_node_id=t["dead_node_id"],
+                dead_rank=t["dead_rank"],
+                survivors=list(t["survivors"]),
+                rdzv_round=t["rdzv_round"],
+                fence_epoch=t["fence_epoch"],
+                started_at=t["started_at"], reason=t.get("reason", ""))
+
+    # --------------------------------------------------------- event builders
+    # Builders allocate/validate under the lock but DO NOT mutate: the
+    # caller journals the event (blocking fsync wait — never under this
+    # lock) and then folds it in with apply().
+
+    def propose_event(self, dead_node_id: int, dead_rank: int,
+                      survivors: List[int], rdzv_round: int,
+                      reason: str = "") -> Optional[Dict]:
+        with self._lock:
+            if self._active is not None and \
+                    self._active["phase"] not in TERMINAL:
+                return None  # one transition at a time
+            if not survivors:
+                return None  # nobody left to absorb the shards
+            self._seq += 1
+            return {"event": "propose", "tid": self._seq,
+                    "dead_node_id": int(dead_node_id),
+                    "dead_rank": int(dead_rank),
+                    "survivors": sorted(int(s) for s in survivors),
+                    "rdzv_round": int(rdzv_round),
+                    "fence_epoch": int(rdzv_round) + 1,
+                    "reason": reason,
+                    # persisted cross-process timestamp — wall clock
+                    "started_at": time.time()}
+
+    def ack_event(self, node_id: int, tid: int, phase: str, ok: bool,
+                  detail: str = "") -> Optional[Dict]:
+        with self._lock:
+            t = self._active
+            if t is None or t["tid"] != tid or t["phase"] in TERMINAL:
+                return None
+            if phase != t["phase"] or node_id not in t["survivors"]:
+                return None
+            return {"event": "ack", "tid": tid, "node_id": int(node_id),
+                    "phase": phase, "ok": bool(ok), "detail": detail}
+
+    def advance_event(self) -> Optional[Dict]:
+        """Phase frame when every survivor acked the current phase."""
+        with self._lock:
+            t = self._active
+            if t is None or t["phase"] in TERMINAL:
+                return None
+            phase = t["phase"]
+            if phase not in PHASES:
+                return None
+            acked = t["acks"].get(phase, {})
+            if any(not ok for ok in acked.values()):
+                return self._abort_locked(t, "survivor nacked "
+                                          f"phase {phase}")
+            if phase == "release":
+                # release has no worker-side ack: the master finishes it
+                # (world rewrite) and advances immediately
+                return {"event": "phase", "tid": t["tid"],
+                        "phase": "done"}
+            if set(acked) >= set(t["survivors"]):
+                nxt = PHASES[PHASES.index(phase) + 1] \
+                    if phase != PHASES[-1] else "done"
+                return {"event": "phase", "tid": t["tid"], "phase": nxt}
+            return None
+
+    def abort_event(self, reason: str) -> Optional[Dict]:
+        with self._lock:
+            t = self._active
+            if t is None or t["phase"] in TERMINAL:
+                return None
+            return self._abort_locked(t, reason)
+
+    def _abort_locked(self, t: Dict, reason: str) -> Dict:
+        return {"event": "abort", "tid": t["tid"], "reason": reason}
+
+    def timed_out(self) -> bool:
+        with self._lock:
+            return (self._active is not None
+                    and self._active["phase"] not in TERMINAL
+                    and self._deadline > 0.0
+                    and time.monotonic() > self._deadline)
+
+    # ----------------------------------------------------------------- fold
+
+    def apply(self, event: Dict) -> bool:
+        """Fold one (journaled) event into state — live path AND replay.
+
+        Pure and deterministic: replaying the journal reproduces the
+        exact phase the master died in.  Returns False for events that
+        no longer apply (stale tid, unknown survivor) — harmless on
+        replay, a client error live."""
+        kind = event.get("event", "")
+        with self._lock:
+            if kind == "propose":
+                if self._active is not None and \
+                        self._active["phase"] not in TERMINAL:
+                    logger.warning("mesh transition %s proposed while %s "
+                                   "active — ignored", event.get("tid"),
+                                   self._active["tid"])
+                    return False
+                self._seq = max(self._seq, int(event["tid"]))
+                self._active = {
+                    "tid": int(event["tid"]), "phase": "propose",
+                    "dead_node_id": int(event["dead_node_id"]),
+                    "dead_rank": int(event["dead_rank"]),
+                    "survivors": list(event["survivors"]),
+                    "rdzv_round": int(event["rdzv_round"]),
+                    "fence_epoch": int(event["fence_epoch"]),
+                    "reason": event.get("reason", ""),
+                    "started_at": float(event.get("started_at", 0.0)),
+                    "acks": {}}
+                self._deadline = time.monotonic() + self.timeout_s
+                return True
+            t = self._active
+            if t is None or t["tid"] != int(event.get("tid", -1)):
+                return False
+            if kind == "ack":
+                t["acks"].setdefault(event["phase"], {})[
+                    int(event["node_id"])] = bool(event.get("ok", True))
+                return True
+            if kind == "phase":
+                t["phase"] = event["phase"]
+                self._deadline = time.monotonic() + self.timeout_s
+                if t["phase"] in TERMINAL:
+                    self._finish_locked(t)
+                return True
+            if kind == "abort":
+                t["phase"] = "aborted"
+                t["reason"] = event.get("reason", t.get("reason", ""))
+                self._finish_locked(t)
+                return True
+        logger.warning("mesh transition: unknown event %r", kind)
+        return False
+
+    def _finish_locked(self, t: Dict):
+        self._history.append(t)
+        if len(self._history) > 100:
+            self._history = self._history[-50:]
+        self._active = None
+        self._deadline = 0.0
+
+    # ------------------------------------------------------------- snapshot
+
+    def export_state(self) -> Dict:
+        with self._lock:
+            return {"seq": self._seq,
+                    "active": dict(self._active) if self._active else None,
+                    "history": [dict(t) for t in self._history]}
+
+    def restore_state(self, data: Dict):
+        with self._lock:
+            self._seq = max(self._seq, int(data.get("seq", 0)))
+            active = data.get("active")
+            self._active = dict(active) if active else None
+            self._history = [dict(t) for t in data.get("history", [])]
+            if self._active is not None:
+                self._deadline = time.monotonic() + self.timeout_s

@@ -74,6 +74,11 @@ class RendezvousManager(ABC):
         # EXACT membership instead of re-running the barrier under the
         # workers that are still training in it
         self.on_world_formed = None
+        # hot-swap fence (master/mesh_transition.py): while a mesh
+        # transition is in flight, formation is HELD — a replacement
+        # node that joins mid-transition parks in the waiting set and
+        # cannot race the fenced cutover with a competing world
+        self._formation_hold = ""
 
     def update_rdzv_params(self, min_nodes: int, max_nodes: int,
                            waiting_timeout: float = 30.0,
@@ -135,7 +140,57 @@ class RendezvousManager(ABC):
         with self._lock:
             self._world_size_policy = policy
 
+    def hold_formation(self, reason: str):
+        """Freeze world formation (hot-swap fence).  Joins still park in
+        the waiting set; `_world_ready` stays False until released."""
+        with self._lock:
+            self._formation_hold = reason or "held"
+            logger.info("%s: formation held (%s)", self.name, reason)
+
+    def release_formation(self):
+        with self._lock:
+            if self._formation_hold:
+                logger.info("%s: formation released (was: %s)", self.name,
+                            self._formation_hold)
+            self._formation_hold = ""
+
+    def evict_from_world(self, node_id: int) -> bool:
+        """Rewrite the CURRENT world without `node_id` — the hot-swap
+        release step.  Survivors keep their relative order but are
+        re-ranked densely; the round bumps (this IS the fencing epoch the
+        survivors adopted), and the new world is journaled via
+        on_world_formed exactly like a barrier-formed one."""
+        with self._lock:
+            ranks = sorted(self._rdzv_world)
+            specs = [self._rdzv_world[r] for r in ranks
+                     if self._rdzv_world[r].node_id != node_id]
+            if len(specs) == len(ranks):
+                return False  # node wasn't in the world
+            self._rdzv_world = {rank: spec
+                                for rank, spec in enumerate(specs)}
+            self._latest_rdzv_nodes = [s.node_id for s in specs]
+            self._alive_nodes.discard(node_id)
+            self._waiting_nodes.pop(node_id, None)
+            self._rdzv_round += 1
+            logger.info("%s: evicted node %s — world round=%d nodes=%s",
+                        self.name, node_id, self._rdzv_round,
+                        self._latest_rdzv_nodes)
+            from ..telemetry import spans as tspans
+
+            tspans.span_event(f"rdzv:{self.name}:world-evict",
+                              {"round": self._rdzv_round,
+                               "evicted": node_id,
+                               "nodes": len(specs)})
+            if self.on_world_formed is not None:
+                try:
+                    self.on_world_formed(self.name, self._export_locked())
+                except Exception:  # noqa: BLE001 — journaling best-effort
+                    logger.exception("world-evict journal hook failed")
+            return True
+
     def _world_ready(self) -> bool:
+        if self._formation_hold:
+            return False
         n = len(self._waiting_nodes)
         if n < self._params.min_nodes:
             return False

@@ -201,6 +201,11 @@ class MasterServicer:
         if isinstance(payload, msg.PolicyHistoryRequest):
             return msg.PolicyHistory(content=m.policy_history_json())
 
+        if isinstance(payload, msg.MeshTransitionQuery):
+            # read-only poll (POLLING class, never journaled): survivors
+            # learn the current hot-swap phase at fusion boundaries
+            return m.mesh.state_message()
+
         if isinstance(payload, msg.TimelineQuery):
             # read-only incident assembly from disk artifacts (never
             # journaled): the answer must stay byte-equal to the offline
@@ -320,6 +325,14 @@ class MasterServicer:
             # history is advisory): a replayed master must not keep the
             # dead node's shards parked in `doing` forever
             self._journal("recover", {"node_id": payload.node_id})
+            # hot-swap route: when the policy says survivors should
+            # absorb the dead rank in place, propose the fenced mesh
+            # transition (its propose frame is journaled by the master)
+            try:
+                m.maybe_start_hotswap(payload.node_id, reason=reason)
+            except Exception:  # noqa: BLE001 — restart-the-world is the
+                # fallback; a failed propose must not fail the verb
+                logger.exception("hot-swap propose failed")
             # tell the agent whether process restarts can fix this class —
             # a user-code error restarts into the same crash every time,
             # and a class repeating across restarts is equally unfixable.
@@ -421,6 +434,26 @@ class MasterServicer:
             # journal frame; a master restart just waits for the next one
             m.collect_serve_stats(payload)
             return msg.OkResponse()
+
+        if isinstance(payload, msg.MeshTransitionPhaseReport):
+            # survivor phase ack: journaled + idem (a retry crossing a
+            # master restart must replay the recorded accept/reject, not
+            # double-ack), journal-BEFORE-apply so the ack is durable
+            # before it can advance the ladder
+            event = m.mesh.ack_event(payload.node_id,
+                                     payload.transition_id, payload.phase,
+                                     payload.ok, payload.detail)
+            if event is None:
+                # stale tid / wrong phase / not a survivor — tell the
+                # worker to re-poll, nothing to journal
+                resp = msg.OkResponse(success=False,
+                                      reason="stale transition or phase")
+                return resp
+            resp = msg.OkResponse()
+            self._journal("mesh_transition", event, idem=idem, resp=resp)
+            m.mesh.apply(event)
+            m.mesh_maybe_advance()
+            return resp
 
         if isinstance(payload, msg.DiagnosisReport):
             return m.diagnosis_manager.collect_report(payload)

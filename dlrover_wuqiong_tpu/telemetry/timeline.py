@@ -59,6 +59,10 @@ TIMELINE_EVENT_KEYS = (
 _RESTORE_STATES = ("restore_shm", "restore_replica", "restore_storage",
                    "rework")
 
+#: ledger states a hot-swap transition credits (trainer/hotswap.py):
+#: hydrate rides ``restore_replica``, cutover rides ``rework``
+_HOTSWAP_STATES = ("restore_replica", "rework")
+
 _JOURNAL_FILE = "journal.frames"
 _SNAPSHOT_FILE = "snapshot.frame"
 
@@ -341,6 +345,39 @@ def build_narrative(journal_events: List[Dict], ledgers: List[Dict]
                         "seq": d["seq"], "reason": dec.get("reason", "")}
         return None
 
+    # mesh_transition frames aggregate per transition id: one journaled
+    # propose→fence→hydrate→cutover→release ladder narrates as ONE
+    # incident (in-place hot-swap, master/mesh_transition.py), anchored
+    # at its propose frame.  Downtime attributes to the two ledger
+    # states the survivor credits (trainer/hotswap.py): restore_replica
+    # for hydrate, rework for cutover.
+    mesh: Dict[int, Dict] = {}
+    for e in journal_events:
+        if e["kind"] != "mesh_transition":
+            continue
+        d = e["data"]
+        tid = int(d.get("tid", 0) or 0)
+        if not tid:
+            continue
+        t = mesh.setdefault(tid, {"phases": [], "final": "propose",
+                                  "acks": 0})
+        ev = str(d.get("event", ""))
+        if ev == "propose":
+            survivors = d.get("survivors")
+            t["dead_node_id"] = d.get("dead_node_id")
+            t["fence_epoch"] = d.get("fence_epoch")
+            t["survivors_n"] = (len(survivors)
+                                if isinstance(survivors, list)
+                                else int(d.get("survivors_n", 0) or 0))
+        elif ev == "phase":
+            ph = str(d.get("phase", ""))
+            t["phases"].append(ph)
+            t["final"] = ph
+        elif ev == "abort":
+            t["final"] = "aborted"
+        elif ev == "ack":
+            t["acks"] += 1
+
     incidents: List[Dict] = []
     for e in journal_events:
         if e["kind"] == "epoch" and int(
@@ -364,6 +401,27 @@ def build_narrative(journal_events: List[Dict], ledgers: List[Dict]
                 "lost_s": round(restore, 6),
                 "trigger": {"kind": "recover", "seq": e["seq"],
                             "node_id": e["data"].get("node_id")},
+                "policy_response": _answer(e["epoch"], e["seq"]),
+            })
+        elif (e["kind"] == "mesh_transition"
+              and str(e["data"].get("event", "")) == "propose"):
+            tid = int(e["data"].get("tid", 0) or 0)
+            t = mesh.get(tid, {})
+            swap = sum(states.get(s, 0.0) for s in _HOTSWAP_STATES)
+            incidents.append({
+                "kind": "mesh_transition",
+                "epoch": e["epoch"], "seq": e["seq"],
+                "t_wall": e["t_wall"],
+                "attributed_state": "hotswap",
+                "lost_s": round(swap, 6),
+                "trigger": {"kind": "mesh_transition", "seq": e["seq"],
+                            "node_id": t.get("dead_node_id"),
+                            "transition_id": tid},
+                "phase": str(t.get("final", "propose")),
+                "phases": list(t.get("phases", [])),
+                "acks": int(t.get("acks", 0)),
+                "fence_epoch": t.get("fence_epoch"),
+                "survivors_n": int(t.get("survivors_n", 0) or 0),
                 "policy_response": _answer(e["epoch"], e["seq"]),
             })
     total = max(wall, sum(states.values()))

@@ -292,6 +292,10 @@ class Trainer:
         from .elastic import init_elastic
 
         self.ctx = init_elastic()
+        # hot-swap participant (trainer/hotswap.py) — attached by the
+        # agent/drill when a replica ring exists; polled at fusion
+        # boundaries alongside the policy decision
+        self.hotswap = None
 
         schedule = self._make_schedule(optax)
         self.optimizer = optimizer or optax.chain(
@@ -421,6 +425,21 @@ class Trainer:
                         cfg["mesh_shape"])
 
     # ------------------------------------------------- adaptive policy
+
+    def _poll_mesh_transition(self) -> None:
+        """Drive the hot-swap participant (trainer/hotswap.py) — fires
+        only at fusion boundaries, on the policy-poll cadence.  The
+        participant is attached by the agent/drill (it carries the
+        replica ring + re-shard hooks the trainer doesn't own); without
+        one this is a no-op."""
+        hs = getattr(self, "hotswap", None)
+        if hs is None:
+            return
+        try:
+            hs.poll()
+        except Exception:  # noqa: BLE001 — a broken participant must
+            # degrade to classic restart-the-world, never kill the loop
+            logger.exception("hot-swap poll failed")
 
     def _poll_policy(self) -> None:
         """Fetch the master's current PolicyDecision (polling verb — a
@@ -1021,6 +1040,7 @@ class Trainer:
                 if a.policy_steps and self.ctx.mc is not None and \
                         s0 % a.policy_steps == 0:
                     self._poll_policy()
+                    self._poll_mesh_transition()
                 pw = None
                 env_mode = (k_eff, env_signature())
                 if self._perf is not None and a.logging_steps and \

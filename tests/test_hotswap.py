@@ -1,0 +1,457 @@
+"""Hot-swap re-mesh tests: state machine, fenced rendezvous, RPC ladder.
+
+Covers master/mesh_transition.py (journal-fold determinism), the
+rendezvous formation fence (hold/evict — a replacement node arriving
+mid-transition must not race the fenced cutover), the full RPC ladder
+over a real servicer, master-crash journal replay resuming the same
+phase, and the worker-side participant (trainer/hotswap.py).
+"""
+
+import pytest
+
+from dlrover_wuqiong_tpu.agent.master_client import MasterClient
+from dlrover_wuqiong_tpu.common import messages as msg
+from dlrover_wuqiong_tpu.common.constants import RendezvousName
+from dlrover_wuqiong_tpu.master.master import JobMaster
+from dlrover_wuqiong_tpu.master.mesh_transition import (
+    MeshTransitionManager,
+    PHASES,
+)
+from dlrover_wuqiong_tpu.master.rendezvous import (
+    ElasticTrainingRendezvousManager,
+)
+from dlrover_wuqiong_tpu.trainer.hotswap import HotSwapParticipant
+
+
+# ------------------------------------------------------------ state machine
+
+
+class TestMeshTransitionManager:
+    def _propose(self, mgr, survivors=(0, 3), rdzv_round=4):
+        e = mgr.propose_event(2, 1, list(survivors), rdzv_round,
+                              reason="test")
+        assert e is not None
+        mgr.apply(e)
+        return e
+
+    def test_phase_ladder_and_fence_epoch(self):
+        mgr = MeshTransitionManager()
+        e = self._propose(mgr)
+        assert e["fence_epoch"] == 5  # rdzv_round + 1
+        assert mgr.active()["phase"] == "propose"
+        for phase in PHASES[:-1]:  # release has no worker acks
+            for nid in (0, 3):
+                a = mgr.ack_event(nid, e["tid"], phase, True)
+                assert a is not None
+                mgr.apply(a)
+            adv = mgr.advance_event()
+            assert adv["event"] == "phase"
+            mgr.apply(adv)
+        assert mgr.active()["phase"] == "release"
+        adv = mgr.advance_event()
+        assert adv == {"event": "phase", "tid": e["tid"], "phase": "done"}
+        mgr.apply(adv)
+        assert mgr.active() is None
+        assert mgr.state_message().phase == "done"
+
+    def test_partial_acks_do_not_advance(self):
+        mgr = MeshTransitionManager()
+        e = self._propose(mgr)
+        a = mgr.ack_event(0, e["tid"], "propose", True)
+        mgr.apply(a)
+        assert mgr.advance_event() is None  # node 3 hasn't acked
+
+    def test_nack_aborts(self):
+        mgr = MeshTransitionManager()
+        e = self._propose(mgr)
+        mgr.apply(mgr.ack_event(0, e["tid"], "propose", True))
+        mgr.apply(mgr.ack_event(3, e["tid"], "propose", False, "no peer"))
+        ab = mgr.advance_event()
+        assert ab["event"] == "abort"
+        mgr.apply(ab)
+        assert mgr.active() is None
+        assert mgr.state_message().phase == "aborted"
+
+    def test_stale_or_foreign_acks_rejected(self):
+        mgr = MeshTransitionManager()
+        e = self._propose(mgr)
+        assert mgr.ack_event(7, e["tid"], "propose", True) is None  # not
+        # a survivor
+        assert mgr.ack_event(0, e["tid"] + 9, "propose", True) is None
+        assert mgr.ack_event(0, e["tid"], "fence", True) is None  # wrong
+        # phase
+
+    def test_one_transition_at_a_time(self):
+        mgr = MeshTransitionManager()
+        self._propose(mgr)
+        assert mgr.propose_event(5, 0, [1], 4) is None
+        assert mgr.propose_event(5, 0, [], 4) is None  # and never with
+        # zero survivors
+
+    def test_event_replay_is_deterministic(self):
+        # the journal IS the state: folding the same frames into a fresh
+        # manager reproduces the exact mid-ladder state (master crash
+        # replay contract)
+        mgr = MeshTransitionManager()
+        events = []
+
+        def rec(e):
+            events.append(e)
+            mgr.apply(e)
+            return e
+
+        rec(mgr.propose_event(2, 1, [0, 3], 4))
+        tid = events[0]["tid"]
+        rec(mgr.ack_event(0, tid, "propose", True))
+        rec(mgr.ack_event(3, tid, "propose", True))
+        rec(mgr.advance_event())
+        rec(mgr.ack_event(0, tid, "fence", True))
+        assert mgr.active()["phase"] == "fence"
+        replayed = MeshTransitionManager()
+        for ev in events:
+            replayed.apply(ev)
+        assert replayed.active() == mgr.active()
+        # replaying ACKS alone never advances — phase frames are the
+        # only authority (a re-run advance decision is the live master's)
+        assert replayed.active()["phase"] == "fence"
+
+    def test_snapshot_roundtrip(self):
+        mgr = MeshTransitionManager()
+        e = self._propose(mgr)
+        mgr.apply(mgr.ack_event(0, e["tid"], "propose", True))
+        restored = MeshTransitionManager()
+        restored.restore_state(mgr.export_state())
+        assert restored.active() == mgr.active()
+        # seq continues past the restored tid — no tid reuse
+        restored.apply({"event": "abort", "tid": e["tid"], "reason": "x"})
+        nxt = restored.propose_event(9, 0, [1], 7)
+        assert nxt["tid"] == e["tid"] + 1
+
+
+# --------------------------------------------------------- formation fence
+
+
+class _AlwaysWarm:
+    def is_warm_world(self, n_nodes: int) -> bool:
+        return True
+
+
+class TestFormationFence:
+    def test_hold_blocks_warm_world_replacement_then_fenced_cutover(self):
+        """Satellite: a replacement node arriving during a pending
+        hot-swap transition must not race the fenced cutover — even down
+        the warm-world fast path, which otherwise forms instantly.  Pins
+        the epoch ordering: round 1 (original world) → 2 (fenced evict)
+        → 3 (replacement integrates after release)."""
+        rdzv = ElasticTrainingRendezvousManager()
+        rdzv.update_rdzv_params(1, 3, waiting_timeout=30.0)
+        rdzv.set_world_size_policy(_AlwaysWarm())
+        rdzv.join_rendezvous(0, 0, 1)
+        rdzv.join_rendezvous(1, 1, 1)
+        rnd, _, world = rdzv.get_comm_world(0)
+        assert rnd == 1 and len(world) == 2
+        rdzv.hold_formation("mesh transition 1: hot-swap of node 1")
+        # replacement arrives mid-transition; warm policy + min_nodes=1
+        # would form a competing world immediately without the hold
+        rdzv.join_rendezvous(2, 1, 1)
+        rnd2, _, w2 = rdzv.get_comm_world(2)
+        assert rnd2 == 1 and w2 == {}
+        # fenced cutover: the evict IS the round bump the survivors
+        # adopted as their fencing epoch
+        assert rdzv.evict_from_world(1)
+        assert rdzv.get_rdzv_round() == 2
+        rnd3, _, w3 = rdzv.get_comm_world(0)
+        assert rnd3 == 2 and len(w3) == 1
+        assert w3[0].node_id == 0
+        # still held: the replacement still cannot form
+        rnd4, _, w4 = rdzv.get_comm_world(2)
+        assert rnd4 == 2 and w4 == {}
+        rdzv.release_formation()
+        rdzv.join_rendezvous(0, 0, 1)
+        rnd5, _, w5 = rdzv.get_comm_world(2)
+        assert rnd5 == 3 and len(w5) == 2
+        assert {s.node_id for s in w5.values()} == {0, 2}
+
+    def test_evict_missing_node_is_noop(self):
+        rdzv = ElasticTrainingRendezvousManager()
+        rdzv.update_rdzv_params(2, 2, waiting_timeout=0.0)
+        rdzv.join_rendezvous(0, 0, 1)
+        rdzv.join_rendezvous(1, 1, 1)
+        rdzv.get_comm_world(0)
+        assert not rdzv.evict_from_world(9)
+        assert rdzv.get_rdzv_round() == 1  # idempotent across replay
+
+    def test_evict_journals_world(self):
+        seen = []
+        rdzv = ElasticTrainingRendezvousManager()
+        rdzv.update_rdzv_params(2, 2, waiting_timeout=0.0)
+        rdzv.on_world_formed = lambda name, state: seen.append(state)
+        rdzv.join_rendezvous(0, 0, 1)
+        rdzv.join_rendezvous(1, 1, 1)
+        rdzv.get_comm_world(0)
+        assert rdzv.evict_from_world(1)
+        assert seen[-1]["round"] == 2
+        assert [v[0] for v in seen[-1]["world"].values()] == [0]
+
+
+# ------------------------------------------------------------- RPC ladder
+
+
+class TestHotSwapOverRpc:
+    @pytest.fixture()
+    def master(self, tmp_path):
+        m = JobMaster(min_nodes=2, max_nodes=2,
+                      journal_dir=str(tmp_path / "journal"))
+        m.prepare()
+        yield m
+        m.stop()
+        MasterClient.reset()
+
+    def _form_world(self, master):
+        c0 = MasterClient(master.addr, node_id=0)
+        c1 = MasterClient(master.addr, node_id=1)
+        c0.register_node(0)
+        c1.register_node(1)
+        c0.join_rendezvous(0, 1, node_ip="127.0.0.1", free_port=4100)
+        c1.join_rendezvous(1, 1, node_ip="127.0.0.1", free_port=4101)
+        assert c0.get_comm_world().complete
+        return c0, c1
+
+    def test_full_ladder_rewrites_world(self, master):
+        c0, c1 = self._form_world(master)
+        c0.report_policy_decision(
+            msg.PolicyDecision(recovery_route="hotswap"))
+        c1.report_failure("SIGKILL", level="node")
+        st = c0.get_mesh_transition()
+        assert st.transition_id == 1 and st.phase == "propose"
+        assert st.dead_node_id == 1 and st.survivors == [0]
+        assert st.rdzv_round == 1 and st.fence_epoch == 2
+        # a replacement joining mid-transition parks behind the fence
+        c2 = MasterClient(master.addr, node_id=2)
+        c2.register_node(2)
+        c2.join_rendezvous(1, 1, node_ip="127.0.0.1", free_port=4102)
+        assert not c2.get_comm_world().complete
+        # the lone survivor walks the ladder; each ack advances
+        for phase in ("propose", "fence", "hydrate", "cutover"):
+            resp = c0.report_mesh_transition_phase(
+                st.transition_id, phase, detail=f"{phase} done")
+            assert resp.success
+        done = c0.get_mesh_transition()
+        assert done.transition_id == 1 and done.phase == "done"
+        # cutover world: survivors only, round bumped to the fence epoch
+        w = c0.get_comm_world()
+        assert w.complete and w.rdzv_round == 2
+        assert [v[0] for v in w.world.values()] == [0]
+        # formation released: the parked replacement can integrate now
+        c0.join_rendezvous(0, 1, node_ip="127.0.0.1", free_port=4100)
+        w2 = c2.get_comm_world()
+        assert w2.complete and w2.rdzv_round == 3
+        assert sorted(v[0] for v in w2.world.values()) == [0, 2]
+
+    def test_nack_falls_back_to_restart_the_world(self, master):
+        c0, c1 = self._form_world(master)
+        c0.report_policy_decision(
+            msg.PolicyDecision(recovery_route="hotswap"))
+        c1.report_failure("SIGKILL", level="node")
+        st = c0.get_mesh_transition()
+        c0.report_mesh_transition_phase(st.transition_id, "propose")
+        resp = c0.report_mesh_transition_phase(
+            st.transition_id, "fence", ok=False, detail="no ring")
+        assert resp.success
+        assert c0.get_mesh_transition().phase == "aborted"
+        # fence released: a classic re-rendezvous can proceed
+        rdzv = master.rdzv_managers[RendezvousName.ELASTIC_TRAINING]
+        assert not rdzv._formation_hold
+
+    def test_stale_ack_rejected(self, master):
+        c0, c1 = self._form_world(master)
+        c0.report_policy_decision(
+            msg.PolicyDecision(recovery_route="hotswap"))
+        c1.report_failure("SIGKILL", level="node")
+        resp = c0.report_mesh_transition_phase(99, "propose")
+        assert not resp.success
+        resp = c0.report_mesh_transition_phase(1, "cutover")  # wrong phase
+        assert not resp.success
+
+    def test_no_hotswap_without_policy_route(self, master):
+        c0, c1 = self._form_world(master)
+        c1.report_failure("SIGKILL", level="node")
+        assert c0.get_mesh_transition().transition_id == 0
+        rdzv = master.rdzv_managers[RendezvousName.ELASTIC_TRAINING]
+        assert not rdzv._formation_hold
+
+
+# ----------------------------------------------------------- crash replay
+
+
+class TestMasterCrashReplay:
+    def test_replay_resumes_same_phase_and_refences(self, tmp_path):
+        jd = str(tmp_path / "journal")
+        m1 = JobMaster(min_nodes=2, max_nodes=2, journal_dir=jd)
+        rdzv = m1.rdzv_managers[RendezvousName.ELASTIC_TRAINING]
+        rdzv.join_rendezvous(0, 0, 1)
+        rdzv.join_rendezvous(1, 1, 1)
+        rdzv.get_comm_world(0)
+        d = msg.PolicyDecision(decision_id=1, recovery_route="hotswap")
+        m1.journal.append("policy", {"decision": d})
+        m1._apply_policy(d)
+        assert m1.maybe_start_hotswap(1, reason="test kill")
+        ack = m1.mesh.ack_event(0, 1, "propose", True)
+        m1._journal_mesh(ack)
+        m1.mesh.apply(ack)
+        m1.mesh_maybe_advance()
+        assert m1.mesh.active()["phase"] == "fence"
+        # SIGKILL: no stop(), no snapshot — replay is frames only
+        m2 = JobMaster(min_nodes=2, max_nodes=2, journal_dir=jd)
+        t = m2.mesh.active()
+        assert t is not None
+        assert t["tid"] == 1 and t["phase"] == "fence"
+        assert t["fence_epoch"] == 2
+        # the fence is re-armed: a replacement still cannot form
+        rdzv2 = m2.rdzv_managers[RendezvousName.ELASTIC_TRAINING]
+        assert rdzv2._formation_hold
+        # and the ladder continues where it stopped
+        ack = m2.mesh.ack_event(0, 1, "fence", True)
+        m2._journal_mesh(ack)
+        m2.mesh.apply(ack)
+        m2.mesh_maybe_advance()
+        assert m2.mesh.active()["phase"] == "hydrate"
+
+    def test_replay_after_release_finishes_evict(self, tmp_path):
+        # crash window: the "release" phase frame was durable but the
+        # world rewrite wasn't — replay must re-run the evict and land
+        # in "done" with the dead node gone
+        jd = str(tmp_path / "journal")
+        m1 = JobMaster(min_nodes=2, max_nodes=2, journal_dir=jd)
+        rdzv = m1.rdzv_managers[RendezvousName.ELASTIC_TRAINING]
+        rdzv.join_rendezvous(0, 0, 1)
+        rdzv.join_rendezvous(1, 1, 1)
+        rdzv.get_comm_world(0)
+        d = msg.PolicyDecision(decision_id=1, recovery_route="hotswap")
+        m1.journal.append("policy", {"decision": d})
+        m1._apply_policy(d)
+        assert m1.maybe_start_hotswap(1)
+        for phase in ("propose", "fence", "hydrate", "cutover"):
+            ack = m1.mesh.ack_event(0, 1, phase, True)
+            m1._journal_mesh(ack)
+            m1.mesh.apply(ack)
+            if phase != "cutover":
+                m1.mesh_maybe_advance()
+        # journal ONLY the advance to "release", then crash before the
+        # master-side evict/done work
+        adv = m1.mesh.advance_event()
+        assert adv == {"event": "phase", "tid": 1, "phase": "release"}
+        m1._journal_mesh(adv)
+        m1.mesh.apply(adv)
+        m2 = JobMaster(min_nodes=2, max_nodes=2, journal_dir=jd)
+        assert m2.mesh.active() is None
+        assert m2.mesh.state_message().phase == "done"
+        rdzv2 = m2.rdzv_managers[RendezvousName.ELASTIC_TRAINING]
+        assert rdzv2.get_rdzv_round() == 2
+        assert not rdzv2._formation_hold
+        _, _, world = rdzv2.get_comm_world(0)
+        assert [s.node_id for s in world.values()] == [0]
+
+
+# ------------------------------------------------------------- participant
+
+
+class _FakeMC:
+    def __init__(self):
+        self.state = msg.MeshTransitionState()
+        self.acks = []
+
+    def get_mesh_transition(self):
+        return self.state
+
+    def report_mesh_transition_phase(self, tid, phase, ok=True, detail=""):
+        self.acks.append((tid, phase, ok, detail))
+        return msg.OkResponse()
+
+
+class TestHotSwapParticipant:
+    def _state(self, phase, tid=1):
+        return msg.MeshTransitionState(
+            transition_id=tid, phase=phase, dead_node_id=2, dead_rank=1,
+            survivors=[0, 3], rdzv_round=4, fence_epoch=5)
+
+    def test_walks_ladder_with_hooks(self):
+        mc = _FakeMC()
+        fences, cuts = [], []
+        hs = HotSwapParticipant(
+            mc, node_id=0,
+            hydrate_cb=lambda st: (11, {"w": [1.0]}, {}),
+            cutover_cb=lambda hydrated, st: cuts.append(hydrated) or True,
+            fence_cb=fences.append)
+        assert hs.poll() is None  # idle: tid 0
+        for phase in ("propose", "fence", "hydrate", "cutover"):
+            mc.state = self._state(phase)
+            assert hs.poll() == phase
+            assert hs.poll() is None  # same phase never re-acked
+        assert [a[1] for a in mc.acks] == ["propose", "fence", "hydrate",
+                                           "cutover"]
+        assert all(a[2] for a in mc.acks)
+        assert fences == [5] and hs.fence_epoch == 5
+        assert cuts == [(11, {"w": [1.0]}, {})]
+        mc.state = self._state("done")
+        assert hs.poll() == "done"
+
+    def test_hydrate_without_ring_nacks(self):
+        mc = _FakeMC()
+        hs = HotSwapParticipant(mc, node_id=0)
+        mc.state = self._state("hydrate")
+        assert hs.poll() == "hydrate"
+        tid, phase, ok, detail = mc.acks[-1]
+        assert not ok and "no replica ring" in detail
+
+    def test_non_survivor_ignores(self):
+        mc = _FakeMC()
+        hs = HotSwapParticipant(mc, node_id=7)
+        mc.state = self._state("propose")
+        assert hs.poll() is None
+        assert mc.acks == []
+
+    def test_ledger_credits_hydrate_and_cutover(self):
+        from dlrover_wuqiong_tpu.telemetry.ledger import GoodputLedger
+
+        led = GoodputLedger()
+        mc = _FakeMC()
+        hs = HotSwapParticipant(
+            mc, node_id=0, ledger=led,
+            hydrate_cb=lambda st: (1, {}, {}),
+            cutover_cb=lambda hydrated, st: True)
+        mc.state = self._state("hydrate")
+        hs.poll()
+        mc.state = self._state("cutover")
+        hs.poll()
+        snap = led.snapshot()
+        assert snap["states"]["restore_replica"] > 0.0
+        assert snap["states"]["rework"] > 0.0
+
+
+# ------------------------------------------------------------ wire pinning
+
+
+class TestMeshWireAddOnly:
+    def test_message_family_canary(self):
+        # ADD-ONLY canary (one per family — the schema lock enforces the
+        # full surface): these fields exist with sentinel defaults so a
+        # mixed-generation decode degrades to no-change
+        st = msg.MeshTransitionState()
+        assert st.transition_id == 0 and st.phase == ""
+        assert st.dead_node_id == -1 and st.dead_rank == -1
+        assert st.survivors == [] and st.fence_epoch == 0
+        q = msg.MeshTransitionQuery()
+        assert q.node_id == -1
+        r = msg.MeshTransitionPhaseReport()
+        assert r.transition_id == 0 and r.ok is True and r.detail == ""
+
+    def test_state_roundtrips_codec(self):
+        from dlrover_wuqiong_tpu.common.serialize import dumps, loads
+
+        st = msg.MeshTransitionState(
+            transition_id=3, phase="hydrate", dead_node_id=2, dead_rank=1,
+            survivors=[0, 3], rdzv_round=4, fence_epoch=5,
+            started_at=123.5, reason="kill")
+        out = loads(dumps(st))
+        assert out == st

@@ -106,10 +106,12 @@ class TestPolicyAlgorithms:
         assert rep([], [], self._cfg(1e9)) == 1
         assert rep([], [], self._cfg(20.0)) == 2
         assert route([], [], self._cfg(1e9)) == ("cold", "shm")
-        # hot regime with a ring: keep the pool warm, restore from peers
+        # hot regime with a ring: survivors can absorb the dead rank in
+        # place from peer replicas — hotswap tops the route ladder
         assert route([], [], self._cfg(20.0, replica_count=2)) == \
-            ("warm", "replica")
+            ("hotswap", "replica")
         # hot regime WITHOUT a ring: warm route but no replica tier
+        # (nothing to hydrate from, so no in-place takeover either)
         assert route([], [], self._cfg(20.0, replica_count=1)) == \
             ("warm", "shm")
 
@@ -177,7 +179,8 @@ class TestPolicyEngine:
         assert burst.ckpt_interval_steps < quiet.ckpt_interval_steps
         assert burst.fused_steps == 1
         assert burst.replica_count == 2
-        assert burst.recovery_route == "warm"
+        # ring exists in the burst regime → in-place takeover route
+        assert burst.recovery_route == "hotswap"
         assert burst.preferred_tier == "replica"
         assert burst.preempt_rate_per_hr > quiet.preempt_rate_per_hr
         assert "mtbf=" in burst.reason

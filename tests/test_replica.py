@@ -16,6 +16,29 @@ from dlrover_wuqiong_tpu.checkpoint.shm_handler import SharedMemoryHandler
 
 
 @pytest.fixture()
+def three_nodes():
+    servers = [ReplicaServer(), ReplicaServer(), ReplicaServer()]
+    for s in servers:
+        s.start()
+    peers = {r: f"127.0.0.1:{s.port}" for r, s in enumerate(servers)}
+    managers = [
+        CkptReplicaManager(rank=r, peers=peers, job_name=f"t-3rep{r}",
+                           replica_count=1, timeout=5.0)
+        for r in range(3)
+    ]
+    yield servers, peers, managers
+    for m in managers:
+        m.close()
+    for r in range(3):
+        SharedMemoryHandler(0, f"t-3rep{r}").unlink()
+    for s in servers:
+        try:
+            s.stop()
+        except Exception:  # noqa: BLE001 — a test may stop one mid-run
+            pass
+
+
+@pytest.fixture()
 def two_nodes():
     servers = [ReplicaServer(), ReplicaServer()]
     for s in servers:
@@ -77,6 +100,122 @@ class TestReplica:
         assert m2._successors() == [0]
         m.close()
         m2.close()
+
+    def test_ring_successors_never_own_address(self):
+        # two agents x two ranks: one ReplicaServer per agent, so ranks
+        # 0/1 share address "a" and ranks 2/3 share "b".  A fan-out >=
+        # len(peers) must NOT route a segment back to its creator's own
+        # server (a "backup" that dies with the node) nor visit one
+        # address twice.
+        peers = {0: "a", 1: "a", 2: "b", 3: "b"}
+        m = CkptReplicaManager(rank=0, peers=peers, job_name="t-shared",
+                               replica_count=4)
+        assert m._successors() == [2]
+        assert m._successors(count=len(peers)) == [2]
+        m.close()
+        # 2-node ring, both ranks on ONE server: no eligible peer at all
+        solo = {0: "a", 1: "a"}
+        m2 = CkptReplicaManager(rank=0, peers=solo, job_name="t-solo",
+                                replica_count=2)
+        assert m2._successors() == []
+        m2.close()
+
+    def test_ring_successors_zero_count(self):
+        m = CkptReplicaManager(rank=0, peers={0: "a", 1: "b"},
+                               job_name="t-zero", replica_count=0)
+        assert m._successors() == []
+        m.close()
+
+    def test_backup_never_ships_to_own_server(self):
+        # both ranks resolve to rank 0's OWN server: backup() must send
+        # nothing (pre-fix it stored a self-copy and reported success)
+        server = ReplicaServer()
+        server.start()
+        peers = {0: f"127.0.0.1:{server.port}",
+                 1: f"127.0.0.1:{server.port}"}
+        m0 = CkptReplicaManager(rank=0, peers=peers, job_name="t-own",
+                                replica_count=1)
+        try:
+            shm = SharedMemoryHandler(0, "t-own")
+            shm.save_state_dict({"x": np.ones(4, np.float32)}, step=3)
+            assert m0.backup() == 0
+            assert server._get(0) is None
+        finally:
+            m0.close()
+            SharedMemoryHandler(0, "t-own").unlink()
+            server.stop()
+
+    def test_restore_fails_over_corrupt_holder(self, three_nodes, tmp_path):
+        # rank 0 ships to both ring successors; the NEAREST holder's
+        # stored blob is then corrupted in place.  restore() must report
+        # + quarantine that holder and fail over to the next one instead
+        # of failing the whole replica tier.
+        servers, peers, (m0, m1, m2) = three_nodes
+        health = []
+        m0.replica_count = 2
+        shm0 = SharedMemoryHandler(0, "t-3rep0")
+        state = {"w": np.arange(16, dtype=np.float32)}
+        shm0.save_state_dict(state, step=5)
+        assert m0.backup() == 2
+        step, blob = servers[1]._store[0]
+        servers[1]._store[0] = (step, blob[:-4] + b"\x00\x00\x00\x00")
+        shm0.unlink()
+        m0b = CkptReplicaManager(rank=0, peers=peers, job_name="t-3rep0",
+                                 replica_count=2,
+                                 health_hook=health.append,
+                                 quarantine_dir=str(tmp_path))
+        try:
+            assert m0b.restore() == 5
+            _, flat, _, _ = SharedMemoryHandler(
+                0, "t-3rep0").load_state_dict()
+            np.testing.assert_array_equal(flat["w"], state["w"])
+            # the skipped holder was reported and its bytes kept as
+            # evidence, never silently absorbed
+            assert health and "holder rank 1" in health[0]
+            blobs = list(tmp_path.glob("owner0-holder1.*.blob"))
+            reasons = list(tmp_path.glob("owner0-holder1.*.reason"))
+            assert blobs and reasons
+        finally:
+            m0b.close()
+
+    def test_restore_fails_over_dead_holder(self, three_nodes):
+        servers, peers, (m0, m1, m2) = three_nodes
+        m0.replica_count = 2
+        shm0 = SharedMemoryHandler(0, "t-3rep0")
+        shm0.save_state_dict({"w": np.full(8, 2.0, np.float32)}, step=9)
+        assert m0.backup() == 2
+        servers[1].stop()  # nearest holder dies with its node
+        shm0.unlink()
+        m0b = CkptReplicaManager(rank=0, peers=peers, job_name="t-3rep0",
+                                 replica_count=2)
+        try:
+            assert m0b.restore() == 9
+        finally:
+            m0b.close()
+
+    def test_fetch_peer_returns_verified_blob(self, three_nodes):
+        # a SURVIVOR pulls the dead rank's segment from its ring holders
+        # without touching its own shm — the hot-swap hydration path
+        from dlrover_wuqiong_tpu.checkpoint.shm_handler import \
+            blob_state_dict
+
+        servers, peers, (m0, m1, m2) = three_nodes
+        state = {"w": np.arange(12, dtype=np.float32).reshape(3, 4)}
+        shm0 = SharedMemoryHandler(0, "t-3rep0")
+        shm0.save_state_dict(state, step=11)
+        assert m0.backup() == 1  # held by rank 1
+        # rank 2 (survivor, NOT a holder) hydrates rank 0's shards
+        fetched = m2.fetch_peer(0)
+        assert fetched is not None
+        step, blob = fetched
+        assert step == 11
+        parsed = blob_state_dict(blob)
+        assert parsed is not None
+        pstep, flat, _ = parsed
+        assert pstep == 11
+        np.testing.assert_array_equal(flat["w"], state["w"])
+        # survivor's own shm untouched
+        assert not m2.has_local_segment()
 
     def test_newer_backup_replaces_older(self, two_nodes):
         _, peers, (m0, m1) = two_nodes
