@@ -23,6 +23,16 @@ dies with the master after 3 gRPC retries):
   (common/comm.py); on a bump this client re-registers the node and
   re-syncs recently acked task results (idempotent — the journaled ones
   answer from the idem cache) before trusting the new world.
+- **failover dialing** (ISSUE 20): ``master_addr`` may be a
+  comma-separated ORDERED endpoint list ("primary,standby").  An
+  unreachable endpoint or a ``NotLeaderError`` answer (a standby or
+  fenced corpse refusing a mutating verb) rotates to the next endpoint;
+  CRITICAL verbs keep rotating inside the outage grace window.  The new
+  connection is pre-seeded with the last observed fencing epoch so the
+  promoted master's higher epoch still fires the one epoch-bump resync,
+  and the ORIGINAL idem keys make retried mutations exactly-once across
+  the failover.  A NotLeaderError re-dial is the ONE sanctioned re-send
+  of an answered RPC: the refusing master never applied the verb.
 """
 
 from __future__ import annotations
@@ -35,7 +45,7 @@ from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 from ..common import messages as msg
-from ..common.comm import MasterUnreachableError, RpcClient
+from ..common.comm import MasterUnreachableError, RpcClient, RpcError
 from ..common.constants import RendezvousName
 from ..common.global_context import get_context
 from ..common.log import get_logger
@@ -56,7 +66,15 @@ class MasterClient:
     def __init__(self, master_addr: str, node_id: int,
                  node_type: str = "worker",
                  outage_grace_s: Optional[float] = None):
-        self._client = RpcClient(master_addr, node_id, node_type)
+        # ordered endpoint list ("primary,standby"): index 0 is dialed
+        # first; _advance_endpoint rotates on unreachable/NotLeader.
+        # The single-endpoint path is byte-for-byte the historical one.
+        self._endpoints = [a.strip() for a in master_addr.split(",")
+                           if a.strip()] or [master_addr]
+        self._endpoint_idx = 0
+        self._failover_lock = threading.Lock()
+        self._failovers = 0
+        self._client = RpcClient(self._endpoints[0], node_id, node_type)
         self._client.on_epoch_change = self._on_epoch_change
         self.master_addr = master_addr
         self.node_id = node_id
@@ -110,31 +128,109 @@ class MasterClient:
         self._idem_seq += 1
         return f"{self._idem_prefix}:{self._idem_seq}"
 
+    @staticmethod
+    def _is_not_leader(exc: Exception) -> bool:
+        """An answered refusal from a standby/fenced master — the verb
+        was NEVER applied there, so re-dialing the next endpoint is the
+        one RpcError that is safe (and required) to re-send."""
+        return isinstance(exc, RpcError) and \
+            not isinstance(exc, MasterUnreachableError) and \
+            "NotLeaderError" in str(exc)
+
+    def _advance_endpoint(self, seen_client: Optional[RpcClient] = None):
+        """Rotate to the next configured endpoint (failover dialing).
+
+        The replacement connection is pre-seeded with the last observed
+        fencing epoch: `_observe_epoch` only fires the bump callback
+        when it has an old value to compare against, and the re-register
+        + idem re-sync on promotion hangs off exactly that callback."""
+        if len(self._endpoints) <= 1:
+            return
+        with self._failover_lock:
+            if seen_client is not None and self._client is not seen_client:
+                return  # another thread already advanced past it
+            old = self._client
+            self._endpoint_idx = (self._endpoint_idx + 1) \
+                % len(self._endpoints)
+            addr = self._endpoints[self._endpoint_idx]
+            new = RpcClient(addr, self.node_id, self.node_type)
+            new.epoch = old.epoch
+            new.on_epoch_change = self._on_epoch_change
+            self._client = new
+            self._failovers += 1
+        old.on_epoch_change = None
+        old.close()
+        logger.warning("failover dialing: master endpoint -> %s", addr)
+
     def _call_critical(self, verb: str, payload, idem: Optional[str] = None):
         """Blocking control-plane verb: ride a master outage with backoff
-        up to the grace deadline, then raise MasterUnreachableError."""
+        up to the grace deadline, then raise MasterUnreachableError.
+
+        With multiple endpoints the grace window is spent ROTATING
+        (fail-fast inner calls) instead of parked on one address — the
+        idem key makes the eventual landing exactly-once wherever the
+        leader turned out to be."""
         t0 = time.monotonic()
-        try:
-            resp = self._client._call(  # noqa: SLF001 — typed facade
-                verb, payload, idem=idem, deadline_s=self._outage_grace_s)
-        except MasterUnreachableError:
-            # wall time burned blocking on a dead master is the
-            # master-outage-degraded ledger split (telemetry/ledger.py)
-            self._account_degraded(time.monotonic() - t0)
-            raise
-        self._maybe_flush()
-        return resp
+        if len(self._endpoints) == 1:
+            try:
+                resp = self._client._call(  # noqa: SLF001 — typed facade
+                    verb, payload, idem=idem,
+                    deadline_s=self._outage_grace_s)
+            except MasterUnreachableError:
+                # wall time burned blocking on a dead master is the
+                # master-outage-degraded ledger split (telemetry/ledger.py)
+                self._account_degraded(time.monotonic() - t0)
+                raise
+            self._maybe_flush()
+            return resp
+        deadline = t0 + self._outage_grace_s
+        backoff = 0.05
+        degraded = False
+        while True:
+            client = self._client
+            try:
+                resp = client._call(verb, payload, idem=idem,  # noqa: SLF001
+                                    attempts=2)
+            except MasterUnreachableError:
+                degraded = True
+            except RpcError as e:
+                if not self._is_not_leader(e):
+                    raise
+                degraded = True
+            else:
+                if degraded:
+                    # the rotation time WAS blocked control-plane time
+                    self._account_degraded(time.monotonic() - t0)
+                self._maybe_flush()
+                return resp
+            if time.monotonic() >= deadline:
+                self._account_degraded(time.monotonic() - t0)
+                raise MasterUnreachableError(
+                    f"no reachable leader among {self._endpoints} within "
+                    f"{self._outage_grace_s:.0f}s grace")
+            self._advance_endpoint(client)
+            time.sleep(min(backoff,
+                           max(0.0, deadline - time.monotonic())))
+            backoff = min(1.0, backoff * 1.5)
 
     def _call_buffered(self, payload, default):
         """Fire-and-forget verb: never blocks training on a dead master —
         a short retry, then the frame parks in the bounded buffer (oldest
         dropped) and `default` is returned; the buffer drains on the next
-        successful call (reconnect or new master)."""
+        successful call (reconnect or new master).  A NotLeaderError
+        answer buffers the SAME way (the standby never applied it) and
+        additionally rotates the endpoint so the next beat lands on the
+        leader — it must never crash the training loop."""
         t0 = time.monotonic()
+        client = self._client
         try:
-            resp = self._client._call(  # noqa: SLF001
+            resp = client._call(  # noqa: SLF001
                 "report", payload, attempts=2)
-        except MasterUnreachableError:
+        except (MasterUnreachableError, RpcError) as e:
+            not_leader = self._is_not_leader(e)
+            if not not_leader and not isinstance(e,
+                                                 MasterUnreachableError):
+                raise
             self._account_degraded(time.monotonic() - t0)
             with self._buffer_lock:
                 if len(self._buffer) >= self.BUFFER_CAP:
@@ -142,6 +238,7 @@ class MasterClient:
                     self._dropped_total += 1
                 self._buffer.append(payload)
                 self._buffered_total += 1
+            self._advance_endpoint(client)
             return default
         self._maybe_flush()
         return resp
@@ -160,8 +257,16 @@ class MasterClient:
 
     def _call_polling(self, verb: str, payload):
         """Advisory verb on a caller-owned cadence: fail fast (the caller's
-        next poll is the retry)."""
-        resp = self._client._call(verb, payload, attempts=2)  # noqa: SLF001
+        next poll is the retry) — but still rotate the endpoint on
+        unreachable/NotLeader so the NEXT poll dials somewhere better."""
+        client = self._client
+        try:
+            resp = client._call(verb, payload, attempts=2)  # noqa: SLF001
+        except (MasterUnreachableError, RpcError) as e:
+            if isinstance(e, MasterUnreachableError) or \
+                    self._is_not_leader(e):
+                self._advance_endpoint(client)
+            raise
         self._maybe_flush()
         return resp
 
@@ -174,16 +279,30 @@ class MasterClient:
                 if not self._buffer:
                     return
                 payload = self._buffer.popleft()
+            client = self._client
             try:
-                self._client._call("report", payload,  # noqa: SLF001
-                                   attempts=1)
+                client._call("report", payload,  # noqa: SLF001
+                             attempts=1)
                 self._flushed_total += 1
             except MasterUnreachableError:
                 with self._buffer_lock:
                     self._buffer.appendleft(payload)
                 return
-            except Exception:  # noqa: BLE001 — a frame the new master
-                # rejects (stale semantics) is dropped, not retried forever
+            except RpcError as e:
+                if self._is_not_leader(e):
+                    # NOT a reject: the non-leader never applied it.
+                    # Re-park the frame and rotate — the drain resumes
+                    # against the real leader on the next success.
+                    with self._buffer_lock:
+                        self._buffer.appendleft(payload)
+                    self._advance_endpoint(client)
+                    return
+                # a frame the new master rejects (stale semantics) is
+                # dropped, not retried forever
+                logger.warning("degraded-buffer frame rejected on flush",
+                               exc_info=True)
+                self._flushed_total += 1
+            except Exception:  # noqa: BLE001 — same reject contract
                 logger.warning("degraded-buffer frame rejected on flush",
                                exc_info=True)
                 self._flushed_total += 1
@@ -224,7 +343,10 @@ class MasterClient:
                 "pending": pending,
                 "reregistrations": self._reregistrations,
                 "epochs_seen": list(self.epochs_seen),
-                "epoch": self.epoch}
+                "epoch": self.epoch,
+                # ADD-ONLY failover-dialing gauges (ISSUE 20)
+                "failovers": self._failovers,
+                "endpoints": list(self._endpoints)}
 
     # ------------------------------------------------------------- dataset
 
@@ -443,15 +565,20 @@ class MasterClient:
 
     # ---------------------------------------------------- incident timeline
 
-    def get_timeline(self, ckpt_dir: str = "") -> msg.TimelineResponse:
+    def get_timeline(self, ckpt_dir: str = "",
+                     journal_dirs: Optional[List[str]] = None
+                     ) -> msg.TimelineResponse:
         """Assembled incident timeline (tools/incident_report.py).
 
         POLLING class: a post-mortem query must fail fast against a dead
         master — the offline reconstruction from the same disk artifacts
-        is the fallback, and it is byte-equal by contract."""
+        is the fallback, and it is byte-equal by contract.
+        ``journal_dirs`` merges further journal dirs after the answering
+        master's own (failover post-mortems span both masters' dirs)."""
         return self._call_polling(
             "get", msg.TimelineQuery(node_id=self.node_id,
-                                     ckpt_dir=ckpt_dir))
+                                     ckpt_dir=ckpt_dir,
+                                     journal_dirs=list(journal_dirs or [])))
 
     # ------------------------------------------------------------- serving
 

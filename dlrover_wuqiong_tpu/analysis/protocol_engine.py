@@ -30,6 +30,12 @@ Rules (catalog + severities in findings.RULE_CATALOG):
   reach ``journal.wait_durable`` before the final return; an async
   enqueue with NO durable-wait gate is flagged (the ack would race the
   batch leader's fsync, un-doing journal-before-ack under a crash).
+  **Failover shape** (ISSUE 20): an ``append_nowait("failover", ...)``
+  in a function that never reaches ``wait_durable`` is flagged under
+  the same id — the fencing handoff's "ack" is the epoch bump itself,
+  and promoting on an un-fsynced fence frame lets the old epoch
+  reappear after a crash (the sanctioned shape is the synchronous
+  ``journal.append``, master/master.py promote_to_leader).
 - ``idem-key-required``: verbs in IDEM_VERBS are retried across master
   restarts and must thread an idempotency key end to end — the servicer
   branch's journal call must carry ``idem=``, and the MasterClient
@@ -650,6 +656,50 @@ def check_lock_leak(path: str, tree: ast.Module,
     return findings
 
 
+# ------------------------------------ rule: failover-frame durability
+
+
+def check_failover_durability(path: str, tree: ast.Module,
+                              source_lines: Sequence[str],
+                              graph: ModuleGraph) -> List[Finding]:
+    """The ``failover`` journal frame IS the fencing handoff (ISSUE 20):
+    its "ack" is the epoch bump the promoting standby performs next, so
+    it must be durable first.  Flags ``append_nowait("failover", ...)``
+    in a function that never gates on ``wait_durable`` — emitted under
+    the existing journal-before-ack id (same invariant, different ack
+    shape)."""
+    findings: List[Finding] = []
+    for info in graph.funcs.values():
+        async_failover: List[ast.Call] = []
+        gated = False
+        for child in ast.walk(info.node):
+            if not isinstance(child, ast.Call):
+                continue
+            term = _terminal(child.func)
+            if term == "wait_durable":
+                gated = True
+            elif term == "append_nowait" and child.args and \
+                    isinstance(child.args[0], ast.Constant) and \
+                    child.args[0].value == "failover":
+                async_failover.append(child)
+        if gated:
+            continue
+        for call in async_failover:
+            if is_suppressed(source_lines, call.lineno,
+                             "journal-before-ack"):
+                continue
+            findings.append(Finding(
+                "journal-before-ack",
+                f"{info.qualname} enqueues the failover frame with "
+                f"append_nowait but never gates on wait_durable — "
+                f"promoting on an un-fsynced fence frame can lose the "
+                f"epoch bump across a crash and resurrect the old "
+                f"leader's epoch; use the synchronous journal.append "
+                f"for the failover frame",
+                path, call.lineno))
+    return findings
+
+
 # ------------------------------------------------------------- entry point
 
 
@@ -659,6 +709,7 @@ CHECKS = (
     check_commit_order,
     check_atomic_publish,
     check_lock_leak,
+    check_failover_durability,
 )
 
 

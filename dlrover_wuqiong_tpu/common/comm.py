@@ -88,13 +88,24 @@ def find_free_port(host: str = "127.0.0.1") -> int:
 
 
 def addr_connectable(addr: str, timeout: float = 1.0) -> bool:
-    """Reference `elastic_run.py:326 _check_to_use_dlrover_run` telnet probe."""
-    try:
-        host, port = addr.rsplit(":", 1)
-        with socket.create_connection((host, int(port)), timeout=timeout):
-            return True
-    except OSError:
-        return False
+    """Reference `elastic_run.py:326 _check_to_use_dlrover_run` telnet probe.
+
+    ``addr`` may be an ordered endpoint list ("primary,standby" — the
+    warm-standby HA form MasterClient dials): connectable when ANY
+    endpoint answers, since the client's failover rotation reaches it.
+    """
+    for one in addr.split(","):
+        one = one.strip()
+        if not one:
+            continue
+        try:
+            host, port = one.rsplit(":", 1)
+            with socket.create_connection((host, int(port)),
+                                          timeout=timeout):
+                return True
+        except OSError:
+            continue
+    return False
 
 
 class RpcServer:

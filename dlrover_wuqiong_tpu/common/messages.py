@@ -332,6 +332,48 @@ class JournalStats:
     batch_max: int = 0
     durable_seq: int = 0
     epoch: int = 0
+    # ADD-ONLY standby/failover gauges (ISSUE 20): shipped_seq is the
+    # highest seq a standby holds or was served; standby_lag_frames is
+    # durable_seq - shipped_seq (-1 = no standby ever fetched);
+    # lease_epoch is the highest leadership-lease epoch this master has
+    # journaled or observed — a revived primary compares it against its
+    # own loaded epoch to self-fence instead of split-braining.
+    shipped_seq: int = 0
+    standby_lag_frames: int = -1
+    lease_epoch: int = 0
+    is_leader: bool = True
+
+
+@message
+class FetchJournalRequest:
+    """Standby → primary: pull journal frames after ``from_seq``
+    (POLLING class, read-only — NEVER journaled: shipping must not
+    write to the log it ships).  The standby's own durable seq is the
+    cursor, so a dropped response or torn batch tail is re-fetched
+    idempotently — frames are immutable once durable."""
+
+    node_id: int = -1
+    from_seq: int = 0
+    max_frames: int = 256
+
+
+@message
+class FetchJournalResponse:
+    """One shipped batch, frames VERBATIM (raw encoded journal lines).
+
+    ``snapshot`` is non-empty only when compaction truncated the
+    requested range: the standby applies its state first, then the tail
+    (which resumes at the compaction epoch marker).  ``durable_seq`` is
+    the primary's watermark at serve time — the standby's lag signal;
+    ``lease_epoch`` carries the primary's current leadership epoch so a
+    tailing standby tracks it even between lease frames."""
+
+    snapshot: bytes = b""
+    snapshot_seq: int = 0
+    frames: List[bytes] = field(default_factory=list)
+    durable_seq: int = 0
+    epoch: int = 0
+    lease_epoch: int = 0
 
 
 # ---------------------------------------------------------------- kv store
@@ -657,6 +699,11 @@ class TimelineQuery:
 
     node_id: int = -1
     ckpt_dir: str = ""
+    # extra journal dirs to merge in (epoch, seq) order — after a
+    # failover the incident spans BOTH masters' journals; the answering
+    # master puts its own dir first, then these, and the offline CLI
+    # passing the same ordered list reproduces the bytes exactly
+    journal_dirs: List[str] = field(default_factory=list)
 
 
 @message

@@ -153,13 +153,20 @@ class FleetMaster:
     def __init__(self, group_commit: bool,
                  max_frames: Optional[int] = None,
                  max_wait_ms: Optional[float] = None,
-                 fsync_floor_ms: float = 0.0):
+                 fsync_floor_ms: float = 0.0,
+                 standby: bool = False):
         self.group_commit = group_commit
         self.max_frames = 1 if not group_commit else (max_frames or 256)
         self.max_wait_ms = max_wait_ms
         self.fsync_floor_ms = fsync_floor_ms
+        # attach a warm standby (master/standby.py) tailing this master's
+        # journal with NO lease (pure mirror, never promotes): the bench
+        # phase proving shipping stays off the commit path (ISSUE 20)
+        self.standby = standby
+        self.standby_addr = ""
         self.addr = ""
         self._proc: Optional[subprocess.Popen] = None
+        self._standby_proc: Optional[subprocess.Popen] = None
         self._work = ""
 
     def __enter__(self) -> "FleetMaster":
@@ -200,6 +207,36 @@ class FleetMaster:
             time.sleep(0.1)
         if not addr_connectable(self.addr):
             raise RuntimeError("fleet master never came up")
+        if self.standby:
+            sb_port = find_free_port()
+            self.standby_addr = f"127.0.0.1:{sb_port}"
+            # a mirror does not need failover-grade 50ms polls: 0.2s
+            # keeps lag to ~one pull of frames while the tailer's wakeup
+            # + fetch cost stays off the same (possibly single) CPU the
+            # measured master is on — the retention gauge compares
+            # THROUGHPUT, and scheduler steal would masquerade as
+            # shipping cost
+            sb_env = dict(env, DWT_STANDBY_POLL_S="0.2")
+            self._standby_proc = subprocess.Popen(
+                [sys.executable, "-m", "dlrover_wuqiong_tpu.master",
+                 f"--port={sb_port}", "--min_nodes=1", "--max_nodes=1",
+                 f"--journal-dir={os.path.join(self._work, 'jrnl-sb')}",
+                 "--poll-interval=1.0", f"--standby-of={self.addr}"],
+                env=sb_env, cwd=self._work, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True)
+            # gate the phase on the mirror actually flowing: the
+            # primary's lag gauge goes live on the standby's first fetch
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                if self._standby_proc.poll() is not None:
+                    raise RuntimeError(
+                        "fleet standby died on startup: "
+                        + (self._standby_proc.stdout.read() or "")[-2000:])
+                if self.journal_stats()["standby_lag_frames"] >= 0:
+                    break
+                time.sleep(0.1)
+            else:
+                raise RuntimeError("fleet standby never fetched")
         return self
 
     def journal_stats(self) -> Dict:
@@ -215,18 +252,21 @@ class FleetMaster:
                     "batches": st.batches, "frames": st.frames,
                     "batch_mean": round(st.batch_mean, 2),
                     "batch_max": st.batch_max,
-                    "durable_seq": st.durable_seq, "epoch": st.epoch}
+                    "durable_seq": st.durable_seq, "epoch": st.epoch,
+                    "shipped_seq": st.shipped_seq,
+                    "standby_lag_frames": st.standby_lag_frames}
         finally:
             cli.close()
 
     def __exit__(self, *exc):
-        if self._proc is not None and self._proc.poll() is None:
-            self._proc.terminate()
-            try:
-                self._proc.wait(timeout=10.0)
-            except subprocess.TimeoutExpired:
-                self._proc.kill()
-                self._proc.wait(timeout=10.0)
+        for proc in (self._standby_proc, self._proc):
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=10.0)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait(timeout=10.0)
         return False
 
 
@@ -283,35 +323,47 @@ def run_fleet(addr: str, clients: int = 200, procs: int = 8,
     return report
 
 
+#: bench phases, interleaved per round: per-frame-fsync baseline,
+#: group-commit default, and group commit with a warm standby attached
+#: (journal shipping must stay OFF the commit path — ISSUE 20)
+_MODES = ("perframe", "grouped", "standby")
+
+
 def fleet_bench(clients: int = 200, procs: int = 8,
                 duration_s: float = 2.0, rounds: int = 2,
                 fsync_floor_ms: float = 3.0) -> Dict:
     """A/B the per-frame-fsync baseline vs group commit, INTERLEAVED.
 
-    Phases alternate baseline/grouped per round (the same same-session
-    interleave discipline as the kernel A/B probes — host load drifts),
-    counts accumulate across rounds, and each phase gets a FRESH master
-    so batch gauges attribute cleanly.  The headline ratio is
-    journaled-verb throughput: grouped / per-frame.
+    Phases alternate per round (the same same-session interleave
+    discipline as the kernel A/B probes — host load drifts), counts
+    accumulate across rounds, and each phase gets a FRESH master so
+    batch gauges attribute cleanly.  The headline ratio is
+    journaled-verb throughput: grouped / per-frame.  The third phase
+    re-runs the grouped shape with a warm STANDBY tailing the journal
+    (no lease — pure mirror): acks gate on the local durable watermark
+    only, so ``standby_retention`` must stay near 1.0 (shipping that
+    re-serialized group commit would crater it) and the phase's journal
+    gauges carry the shipped-seq/lag evidence.
 
     ``fsync_floor_ms`` pads each journal sync to the PRODUCTION storage
     regime (network-attached PD-class disks: 1-5ms per sync; this host's
     local NVMe fsyncs in ~0.1ms, which no real master journal rides).
-    Both phases pay the SAME floor per sync — group commit amortizes it,
+    All phases pay the SAME floor per sync — group commit amortizes it,
     per-frame eats it per RPC — and the floor used is reported in every
     phase's journal gauges.  Pass 0 to measure bare local-disk fsync.
     """
     acc: Dict[str, Dict] = {}
-    for mode in ("perframe", "grouped"):
+    for mode in _MODES:
         acc[mode] = {c: {"count": 0} for c in VERB_CLASSES}
         acc[mode]["lat"] = {c: [] for c in VERB_CLASSES}
         acc[mode]["seconds"] = 0.0
         acc[mode]["errors"] = 0
         acc[mode]["journal"] = {}
     for _ in range(max(1, rounds)):
-        for mode in ("perframe", "grouped"):
-            with FleetMaster(group_commit=(mode == "grouped"),
-                             fsync_floor_ms=fsync_floor_ms) as fm:
+        for mode in _MODES:
+            with FleetMaster(group_commit=(mode != "perframe"),
+                             fsync_floor_ms=fsync_floor_ms,
+                             standby=(mode == "standby")) as fm:
                 got = run_fleet(fm.addr, clients=clients, procs=procs,
                                 duration_s=duration_s)
                 acc[mode]["seconds"] += duration_s
@@ -324,7 +376,7 @@ def fleet_bench(clients: int = 200, procs: int = 8,
                 acc[mode]["journal"] = fm.journal_stats()
     out: Dict = {"clients": clients, "procs": procs, "rounds": rounds,
                  "fsync_floor_ms": fsync_floor_ms}
-    for mode in ("perframe", "grouped"):
+    for mode in _MODES:
         secs = acc[mode]["seconds"] or 1.0
         summ = {"rpc_p99_ms": acc[mode]["rpc_p99_ms"],
                 "rpc_errors": acc[mode]["errors"],
@@ -340,7 +392,14 @@ def fleet_bench(clients: int = 200, procs: int = 8,
         out[mode] = summ
     base = out["perframe"]["journaled"]["rpc_per_s"]
     grouped = out["grouped"]["journaled"]["rpc_per_s"]
+    shipped = out["standby"]["journaled"]["rpc_per_s"]
     out["journaled_speedup"] = round(grouped / base, 2) if base else 0.0
+    # the ISSUE 20 acceptance gauge: journaled rpc/s retained with a
+    # standby attached (>= 0.9 of no-standby proves shipping is async)
+    out["standby_retention"] = (round(shipped / grouped, 3)
+                                if grouped else 0.0)
+    out["standby_lag_frames"] = out["standby"]["journal"].get(
+        "standby_lag_frames", -1)
     return out
 
 

@@ -6,6 +6,13 @@ out-of-process deployment shape (one master pod per job).  With
 (master/journal.py); a replacement process started on the same directory
 replays the state, bumps the fencing epoch, and the workers ride through
 (`python -m dlrover_wuqiong_tpu.chaos master-kill` is the proof drill).
+
+Warm-standby HA (ISSUE 20): ``--standby-of HOST:PORT`` starts this
+process as a journal-tailing mirror of a running primary
+(master/standby.py) that promotes itself with a fenced epoch bump when
+the leadership lease expires; ``--lease-ttl`` arms the lease on both
+sides and ``--peer`` lets a revived primary discover it was failed over
+and self-fence read-only (`chaos master-failover` is the proof drill).
 """
 
 from __future__ import annotations
@@ -46,6 +53,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="batch leader linger before fsync (default from "
                         "DWT_JOURNAL_GROUP_MAX_WAIT_MS, else 0: a single "
                         "writer pays no extra latency)")
+    p.add_argument("--standby-of", default="",
+                   help="run as a warm standby tailing this primary "
+                        "(HOST:PORT); requires --journal-dir for the "
+                        "mirror, promotes on lease expiry")
+    p.add_argument("--peer", default="",
+                   help="the OTHER master's HOST:PORT: a restarting "
+                        "primary probes it and self-fences read-only if "
+                        "a standby was promoted meanwhile")
+    p.add_argument("--lease-ttl", type=float, default=0.0,
+                   help="leadership lease ttl seconds (0 disables HA: "
+                        "no lease frames, a standby never promotes)")
     args = p.parse_args(argv)
     return run_master_forever(
         args.port, args.min_nodes, args.max_nodes, node_unit=args.node_unit,
@@ -53,7 +71,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         poll_interval=args.poll_interval, max_seconds=args.max_seconds,
         policy=args.policy, policy_prior=args.policy_prior,
         group_commit_max_frames=args.group_commit_max_frames,
-        group_commit_max_wait_ms=args.group_commit_max_wait_ms)
+        group_commit_max_wait_ms=args.group_commit_max_wait_ms,
+        standby_of=args.standby_of, peer=args.peer,
+        lease_ttl_s=args.lease_ttl)
 
 
 if __name__ == "__main__":

@@ -4,6 +4,20 @@ Parity: reference `dlrover/python/master/main.py` (run :43),
 `master/master.py` (JobMaster ABC), `master/dist_master.py:86`
 (DistributedJobMaster composing JobManager/TaskManager/RendezvousManagers/
 SpeedMonitor/DiagnosisManager + servicer), `master/local_master.py:38`.
+
+Warm standby + fenced failover (ISSUE 20): the reference has no master
+HA at all — a dead master means a dead job until the operator restarts
+it.  Here a second master can run in STANDBY mode (master/standby.py
+tails this one's journal over `fetch_journal`) and take over with a
+fenced epoch bump when the leadership lease expires.  Leadership is a
+journal artifact, not a runtime one: the leader heartbeats ``lease``
+frames into its own journal (shipped like every other frame), promotion
+appends a ``failover`` frame BEFORE the new epoch serves, and a revived
+old primary compares epochs with its ``--peer`` before re-opening its
+own — a lower epoch means it self-fences READ-ONLY (the servicer's
+NotLeaderError gate) instead of split-braining the fleet.  ``is_leader``
+is therefore the single switch the servicer, the journal compaction on
+stop, and the lease thread all key on.
 """
 
 from __future__ import annotations
@@ -11,7 +25,7 @@ from __future__ import annotations
 import os
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from ..common import messages as msg
 from ..common.constants import JobExitReason, RendezvousName
@@ -46,7 +60,10 @@ class JobMaster:
                  journal_dir: Optional[str] = None,
                  policy_engine=None,
                  group_commit_max_frames: Optional[int] = None,
-                 group_commit_max_wait_ms: Optional[float] = None):
+                 group_commit_max_wait_ms: Optional[float] = None,
+                 standby: bool = False,
+                 peer: str = "",
+                 lease_ttl_s: float = 0.0):
         ctx = get_context()
         self.speed_monitor = SpeedMonitor(ctx.train_speed_record_num)
         self.job_manager = job_manager or LocalJobManager(scaler=scaler)
@@ -137,6 +154,23 @@ class JobMaster:
 
         self.idem_cache = IdemCache()
         self.epoch = 1
+        # ----------------------------------------------------- leadership
+        # warm-standby failover (master/standby.py): a standby mirrors
+        # the primary's journal and is NOT the leader until promoted; a
+        # revived primary that discovers a higher epoch at its peer
+        # self-fences read-only.  The servicer's NotLeaderError gate
+        # rejects every mutating verb while is_leader is False.
+        self.standby = bool(standby)
+        self.peer = peer
+        self.lease_ttl_s = float(lease_ttl_s)
+        # is_leader flips from the lease thread (mid-run peer fence),
+        # the boot path (corpse fence) and promote_to_leader — one lock
+        # covers every write so a fence can never be lost to a racing
+        # promotion's read-modify-write
+        self._leader_lock = threading.Lock()
+        self.is_leader = not standby
+        self._lease_epoch_seen = 0
+        self._lease_thread: Optional[threading.Thread] = None
         jd = journal_dir or os.getenv("DWT_MASTER_JOURNAL_DIR", "")
         self.journal = MasterJournal(
             jd, snapshot_every=ctx.journal_snapshot_every,
@@ -145,10 +179,21 @@ class JobMaster:
         ) if jd else None
         if self.journal is not None:
             self._replay_journal()
-            self.epoch = self.journal.open_epoch()
-            for name, rdzv in self.rdzv_managers.items():
-                rdzv.on_world_formed = self._journal_world
-            self._mesh_resume_after_replay()
+            if self.standby:
+                # mirror mode: fold the shipped history but do NOT bump
+                # the fencing epoch or arm the leader-only callbacks —
+                # promote_to_leader() does both, exactly once
+                self.epoch = max(1, self.journal.epoch)
+            else:
+                if self.peer:
+                    self._maybe_fence_on_peer()
+                if self.is_leader:
+                    self.epoch = self.journal.open_epoch()
+                    for name, rdzv in self.rdzv_managers.items():
+                        rdzv.on_world_formed = self._journal_world
+                    self._mesh_resume_after_replay()
+                else:
+                    self.epoch = max(1, self.journal.epoch)
         self._server = create_master_service(self, port=port)
         self._exit_code = 0
         self._exit_reason = ""
@@ -193,8 +238,12 @@ class JobMaster:
         if self.journal is not None:
             # clean shutdown: compact so the next incarnation boots from
             # one snapshot frame (crash paths never reach here — replay
-            # covers them)
-            self.snapshot_journal()
+            # covers them).  LEADER ONLY: a standby/fenced mirror must
+            # stay a verbatim prefix of the primary's log — compacting
+            # it would break the (epoch, seq) dedup the merged incident
+            # timeline relies on.
+            if self.is_leader:
+                self.snapshot_journal()
             self.journal.close()
 
     # ------------------------------------------------------- fault tolerance
@@ -311,6 +360,20 @@ class JobMaster:
             self.serve_queue.complete(data["results"])
         elif kind == "mesh_transition":
             self.mesh.apply(data)
+        elif kind == "lease":
+            # leadership lease heartbeat (ISSUE 20): replay restores the
+            # fencing baseline a revived master compares against its peer
+            with self._leader_lock:
+                self._lease_epoch_seen = max(
+                    self._lease_epoch_seen,
+                    int(data.get("lease_epoch", 0)))
+        elif kind == "failover":
+            # standby takeover record: new_epoch is the fence every
+            # later incarnation must clear (also the timeline's
+            # `failover` incident anchor)
+            with self._leader_lock:
+                self._lease_epoch_seen = max(
+                    self._lease_epoch_seen, int(data.get("new_epoch", 0)))
         else:
             logger.warning("journal replay: unknown frame kind %r", kind)
         if idem:
@@ -339,6 +402,150 @@ class JobMaster:
                 self.journal.snapshot(self._journal_state())
             except Exception:  # noqa: BLE001 — compaction must not kill
                 logger.exception("journal snapshot failed")
+
+    # --------------------------------------------------- leadership + lease
+
+    def _peer_journal_stats(self, timeout_s: float = 2.0):
+        """Best-effort epoch probe of the peer master (read-only verb).
+
+        Returns the peer's JournalStats or None when it is unreachable
+        or errored — callers treat None as "no evidence", never as
+        permission to fence or to lead."""
+        if not self.peer:
+            return None
+        from ..common.comm import RpcClient, RpcError
+
+        client = RpcClient(self.peer, node_id=-2, node_type="master",
+                           timeout=timeout_s, retries=2,
+                           base_delay_s=0.05, max_delay_s=0.2)
+        try:
+            return client.get(msg.JournalStatsQuery())
+        except RpcError:  # MasterUnreachableError subclasses RpcError
+            return None
+        finally:
+            client.close()
+
+    def _maybe_fence_on_peer(self):
+        """Revived-corpse check, BEFORE this master opens its own epoch.
+
+        A promoted standby journals a ``failover`` frame and serves an
+        epoch strictly above anything the old primary ever issued
+        (promote_to_leader bumps past the max of its mirrored epoch and
+        lease epoch).  So if the peer answers with a higher epoch than
+        everything in OUR journal, we are the corpse: stay read-only and
+        never open_epoch — a corpse that bumped would collide with or
+        overtake the legitimate leader (split-brain).  An unreachable
+        peer is NOT evidence — the common case is the primary booting
+        first while the standby is still down."""
+        stats = self._peer_journal_stats()
+        if stats is None:
+            return
+        peer_epoch = max(int(getattr(stats, "epoch", 0)),
+                         int(getattr(stats, "lease_epoch", 0)))
+        mine = max(self.journal.epoch, self._lease_epoch_seen)
+        if peer_epoch > mine:
+            with self._leader_lock:
+                self.is_leader = False
+            logger.warning(
+                "FENCED read-only: peer %s serves epoch %d > local %d — "
+                "a standby was promoted while this master was down",
+                self.peer, peer_epoch, mine)
+
+    def start_lease_heartbeat(self):
+        """Leader half of the lease protocol: journal a ``lease`` frame
+        every ttl/3 so the shipped log itself carries liveness — the
+        standby promotes after ttl of lease silence, no side channel.
+        With a ``--peer``, each beat first probes the peer's epoch and
+        self-fences if a promotion happened behind our back (the
+        wedged-but-alive primary case)."""
+        if self.lease_ttl_s <= 0 or self.journal is None \
+                or not self.is_leader:
+            return
+        if self._lease_thread is not None and self._lease_thread.is_alive():
+            return
+        self._lease_thread = threading.Thread(
+            target=self._lease_loop, name="dwt-lease", daemon=True)
+        self._lease_thread.start()
+
+    def _lease_loop(self):
+        interval = max(0.05, self.lease_ttl_s / 3.0)
+        while not self._stopped.wait(interval):
+            if not self.is_leader:
+                return
+            if self.peer:
+                stats = self._peer_journal_stats(
+                    timeout_s=max(0.5, interval))
+                if stats is not None and \
+                        max(int(getattr(stats, "epoch", 0)),
+                            int(getattr(stats, "lease_epoch", 0))) > \
+                        max(self.epoch, self._lease_epoch_seen):
+                    # fence FIRST, before another lease frame could
+                    # claim a leadership we already lost
+                    with self._leader_lock:
+                        self.is_leader = False
+                    logger.warning(
+                        "FENCED read-only mid-run: peer %s overtook "
+                        "epoch %d", self.peer, self.epoch)
+                    return
+            try:
+                self.journal.append("lease", {
+                    "holder": str(os.getpid()),
+                    "lease_epoch": self.epoch,
+                    "ttl_s": self.lease_ttl_s})
+                with self._leader_lock:
+                    self._lease_epoch_seen = max(self._lease_epoch_seen,
+                                                 self.epoch)
+            except Exception:  # noqa: BLE001 — a failed beat must not
+                # kill the thread; ttl of silence hands over leadership
+                logger.exception("lease heartbeat append failed")
+
+    def promote_to_leader(self, observed_epoch: int = 0) -> int:
+        """Fenced standby takeover: journal-first, then serve.
+
+        The ``failover`` frame is durably appended (sync append — a
+        crash mid-promotion replays as a plain mirror, never a
+        half-leader) BEFORE the new epoch becomes visible.  The new
+        epoch lands strictly ABOVE anything the old primary could have
+        issued: a naive corpse restart on epoch E re-opens at E+1, so
+        promotion re-opens at observed+2."""
+        if self.is_leader or self.journal is None:
+            return self.epoch
+        observed = max(int(observed_epoch), self.journal.epoch,
+                       self._lease_epoch_seen, self.epoch)
+        last_seq = self.journal.group_commit_stats()["durable_seq"]
+        self.journal.append("failover", {
+            "from_epoch": self.journal.epoch,
+            "new_epoch": observed + 2,
+            "last_shipped_seq": last_seq,
+            "holder": str(os.getpid())})
+        self.journal.epoch = observed + 1
+        self.epoch = self.journal.open_epoch()
+        with self._leader_lock:
+            self._lease_epoch_seen = max(self._lease_epoch_seen,
+                                         self.epoch)
+            self.is_leader = True
+        for name, rdzv in self.rdzv_managers.items():
+            rdzv.on_world_formed = self._journal_world
+        self._mesh_resume_after_replay()
+        self.start_lease_heartbeat()
+        logger.warning("PROMOTED to leader: epoch %d (fenced above %d), "
+                       "last mirrored seq %d", self.epoch, observed,
+                       last_seq)
+        return self.epoch
+
+    def fetch_journal(self, from_seq: int,
+                      max_frames: int = 256) -> msg.FetchJournalResponse:
+        """Serve one standby pull (POLLING verb — read-only, never
+        journaled): durable frames after ``from_seq`` verbatim, plus the
+        snapshot handoff when compaction truncated the range."""
+        if self.journal is None:
+            return msg.FetchJournalResponse(epoch=self.epoch)
+        snap, snap_seq, frames, durable = self.journal.fetch_batch(
+            from_seq, max_frames)
+        return msg.FetchJournalResponse(
+            snapshot=snap, snapshot_seq=snap_seq, frames=frames,
+            durable_seq=durable, epoch=self.epoch,
+            lease_epoch=self._lease_epoch_seen)
 
     # --------------------------------------------------------------- hooks
 
@@ -476,10 +683,17 @@ class JobMaster:
             nodes=len(snapshots))
 
     def journal_stats(self) -> msg.JournalStats:
-        """Group-commit gauges (read-only poll, never journaled)."""
+        """Group-commit + standby gauges (read-only poll, never
+        journaled).  lease_epoch/is_leader are what a peer's fence
+        probe compares against — they must reflect the journal, not
+        wishes."""
         if self.journal is None:
-            return msg.JournalStats(enabled=False, epoch=self.epoch)
+            return msg.JournalStats(enabled=False, epoch=self.epoch,
+                                    lease_epoch=self._lease_epoch_seen,
+                                    is_leader=self.is_leader)
         return msg.JournalStats(enabled=True, epoch=self.epoch,
+                                lease_epoch=self._lease_epoch_seen,
+                                is_leader=self.is_leader,
                                 **self.journal.group_commit_stats())
 
     # ------------------------------------------------------------- serving
@@ -536,19 +750,25 @@ class JobMaster:
         return json.dumps([dataclasses.asdict(d)
                            for d in self._policy_decisions])
 
-    def timeline_report(self, ckpt_dir: str = "") -> msg.TimelineResponse:
+    def timeline_report(self, ckpt_dir: str = "",
+                        journal_dirs: Optional[List[str]] = None
+                        ) -> msg.TimelineResponse:
         """Assembled incident timeline (telemetry/timeline.py) over this
         master's journal dir + the caller's flight-dump root.
 
         Deliberately a pure function of the DISK artifacts, not the
         in-memory managers: `tools/incident_report.py --journal/--flight`
         on the same paths must reconstruct byte-equal canonical JSON
-        (chaos master-kill / serve-drain gate on exactly that)."""
+        (chaos master-kill / serve-drain gate on exactly that).
+        ``journal_dirs`` adds further journals (a failover's OTHER
+        master) merged in (epoch, seq) order with byte-exact dedup —
+        the offline CLI passes the same ordered list."""
         from ..telemetry import timeline as tl
 
         journal_dir = self.journal.dir if self.journal is not None else ""
         report = tl.assemble_incident(journal_dir=journal_dir,
-                                      ckpt_dir=ckpt_dir)
+                                      ckpt_dir=ckpt_dir,
+                                      journal_dirs=list(journal_dirs or []))
         return msg.TimelineResponse(content=tl.incident_json(report),
                                     events=len(report["events"]))
 
@@ -771,6 +991,21 @@ class JobMaster:
                     self._exit_code)
         return self._exit_code
 
+    def run_fenced(self, poll_interval: float = 5.0,
+                   max_seconds: Optional[float] = None) -> int:
+        """Read-only corpse loop: keep serving polls (timeline, stats,
+        kv reads) while the servicer's NotLeaderError gate bounces every
+        mutating verb to the real leader.  Exits only on stop/timeout —
+        a fenced master never reclaims leadership on its own."""
+        start = time.monotonic()
+        logger.warning("running FENCED read-only at epoch %d (leader is "
+                       "elsewhere)", self.epoch)
+        while not self._stopped.wait(poll_interval):
+            if max_seconds and time.monotonic() - start > max_seconds:
+                break
+        logger.info("fenced master exiting (epoch %d)", self.epoch)
+        return 0
+
     def _collect_metrics(self):
         """Push job state into the registry each poll cycle."""
         try:
@@ -799,20 +1034,45 @@ def run_master_forever(port: int, min_nodes: int, max_nodes: int,
                        policy: bool = False,
                        policy_prior: str = "",
                        group_commit_max_frames: Optional[int] = None,
-                       group_commit_max_wait_ms: Optional[float] = None):
-    """Entry for a standalone master process (parity master/main.py:63)."""
+                       group_commit_max_wait_ms: Optional[float] = None,
+                       standby_of: str = "",
+                       peer: str = "",
+                       lease_ttl_s: float = 0.0):
+    """Entry for a standalone master process (parity master/main.py:63).
+
+    ``standby_of`` starts in warm-standby mode (master/standby.py):
+    mirror the primary's journal, promote on lease expiry, then fall
+    into the normal run loop.  ``peer`` + ``lease_ttl_s`` arm the
+    leader side: lease heartbeats into the journal and the
+    revived-corpse fence check against the peer."""
     engine = None
     if policy:
         from ..brain.policy import PolicyEngine
 
         engine = PolicyEngine(prior_path=policy_prior)
+    if standby_of:
+        from .standby import run_standby
+
+        return run_standby(
+            primary_addr=standby_of, port=port, min_nodes=min_nodes,
+            max_nodes=max_nodes, node_unit=node_unit,
+            journal_dir=journal_dir, poll_interval=poll_interval,
+            max_seconds=max_seconds, lease_ttl_s=lease_ttl_s,
+            policy_engine=engine,
+            group_commit_max_frames=group_commit_max_frames,
+            group_commit_max_wait_ms=group_commit_max_wait_ms)
     master = JobMaster(port=port, min_nodes=min_nodes, max_nodes=max_nodes,
                        node_unit=node_unit, journal_dir=journal_dir,
                        policy_engine=engine,
                        group_commit_max_frames=group_commit_max_frames,
-                       group_commit_max_wait_ms=group_commit_max_wait_ms)
+                       group_commit_max_wait_ms=group_commit_max_wait_ms,
+                       peer=peer, lease_ttl_s=lease_ttl_s)
     master.prepare()
     try:
+        if not master.is_leader:
+            return master.run_fenced(poll_interval=poll_interval,
+                                     max_seconds=max_seconds)
+        master.start_lease_heartbeat()
         return master.run(poll_interval=poll_interval,
                           max_seconds=max_seconds)
     finally:

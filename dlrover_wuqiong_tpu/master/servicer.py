@@ -27,6 +27,31 @@ from ..common.constants import NodeEventType, NodeStatus
 logger = get_logger("servicer")
 
 
+class NotLeaderError(RuntimeError):
+    """Mutating verb hit a standby or fenced master (ISSUE 20).
+
+    Surfaces client-side as an RpcError whose text carries this class
+    name — MasterClient treats it as "advance to the next endpoint",
+    the ONE sanctioned re-dial of an answered RPC (the verb was never
+    applied here, so re-sending it to the real leader is safe, and the
+    original idem key keeps it exactly-once)."""
+
+
+#: verbs a non-leader still answers: pure reads with no queue/state
+#: movement.  Everything else gets NotLeaderError BEFORE the idem cache
+#: — a fenced corpse's replayed cache may be stale relative to the
+#: promoted standby, so mutations must be answered by the leader only.
+READ_ONLY_VERBS = (
+    "CommWorldRequest", "WaitingNodeNumRequest", "NetworkReadyRequest",
+    "StragglerExistRequest", "KVStoreGetRequest",
+    "KVStoreMultiGetRequest", "ShardCheckpointRequest",
+    "ParallelConfigRequest", "GoodputQuery", "PerfQuery",
+    "JournalStatsQuery", "FetchJournalRequest", "ServeResultQuery",
+    "ServeStatsQuery", "PolicyStateRequest", "PolicyHistoryRequest",
+    "MeshTransitionQuery", "TimelineQuery",
+)
+
+
 class MasterServicer:
     def __init__(self, job_master):
         self.m = job_master
@@ -35,6 +60,11 @@ class MasterServicer:
 
     def handle(self, verb: str, node_id: int, node_type: str,
                payload: Any, idem: Optional[str] = None) -> Any:
+        if not getattr(self.m, "is_leader", True) and \
+                type(payload).__name__ not in READ_ONLY_VERBS:
+            raise NotLeaderError(
+                f"not the leader (epoch {getattr(self.m, 'epoch', 0)}) — "
+                f"{type(payload).__name__} must go to the active primary")
         cache = getattr(self.m, "idem_cache", None)
         if idem and cache is not None:
             hit = cache.get(idem)
@@ -171,6 +201,13 @@ class MasterServicer:
             # sizes + durable watermark for the fleet bench and perf_probe
             return m.journal_stats()
 
+        if isinstance(payload, msg.FetchJournalRequest):
+            # standby pull (POLLING class, read-only, NEVER journaled —
+            # journaling a fetch would make shipping feed itself):
+            # durable frames after from_seq verbatim, snapshot handoff
+            # when compaction already truncated the range
+            return m.fetch_journal(payload.from_seq, payload.max_frames)
+
         if isinstance(payload, msg.ServeLeaseRequest):
             leased = m.serve_queue.lease(payload.node_id,
                                          payload.max_requests)
@@ -210,7 +247,9 @@ class MasterServicer:
             # read-only incident assembly from disk artifacts (never
             # journaled): the answer must stay byte-equal to the offline
             # reconstruction, so no in-memory state contributes
-            return m.timeline_report(payload.ckpt_dir)
+            return m.timeline_report(
+                payload.ckpt_dir,
+                journal_dirs=list(payload.journal_dirs))
 
         raise ValueError(f"unknown get message: {type(payload).__name__}")
 

@@ -35,6 +35,15 @@ answer and the offline `tools/incident_report.py --journal/--flight`
 reconstruction are byte-equal, which chaos master-kill and serve-drain
 gate on.
 
+Across a warm-standby failover (ISSUE 20) the incident spans TWO
+journal dirs — the old primary's and the promoted standby's.  Because
+journal shipping mirrors frames VERBATIM (master/journal.py
+ingest_frames) the shared prefix is byte-identical in both dirs, so
+`read_journal_events_multi` dedups on ``(epoch, seq, kind)`` first-wins
+in dir order and the union still reads as ONE causal log; the ``epoch``
+frame a `failover` frame announced narrates as a ``failover`` incident
+instead of a ``master_restart``.
+
 The event envelope (`TIMELINE_EVENT_KEYS`) is ADD-ONLY, pinned by
 tests/test_timeline.py.
 """
@@ -197,6 +206,37 @@ def read_journal_events(journal_dir: str) -> List[Dict]:
             "journal", kind, f"journal:{kind}", last_wall, epoch=epoch,
             seq=seq, role="master", data=_frame_data(kind, data)))
     return events
+
+
+def read_journal_events_multi(journal_dirs: List[str]) -> List[Dict]:
+    """Events from one or more journal dirs as ONE (epoch, seq) stream.
+
+    A warm standby's journal (master/standby.py) is a verbatim mirror
+    of the primary's plus its own post-promotion tail, so across a
+    failover the SAME frame exists byte-identical in both dirs: dedup
+    is ``(epoch, seq, kind)`` first-wins in dir order, then the union
+    sorts by ``(epoch, seq)`` and ``t_wall`` re-clamps nondecreasing.
+    With zero or one dirs this IS `read_journal_events` — the
+    single-journal path stays byte-identical.
+    """
+    dirs = [d for d in journal_dirs if d]
+    if len(dirs) <= 1:
+        return read_journal_events(dirs[0] if dirs else "")
+    seen: set = set()
+    merged: List[Dict] = []
+    for d in dirs:
+        for e in read_journal_events(d):
+            key = (e["epoch"], e["seq"], e["kind"])
+            if key in seen:
+                continue
+            seen.add(key)
+            merged.append(e)
+    merged.sort(key=lambda e: (e["epoch"], e["seq"]))
+    last_wall = 0.0
+    for e in merged:
+        last_wall = max(last_wall, e["t_wall"])
+        e["t_wall"] = last_wall
+    return merged
 
 
 # ---------------------------------------------------------- flight side
@@ -378,12 +418,21 @@ def build_narrative(journal_events: List[Dict], ledgers: List[Dict]
         elif ev == "ack":
             t["acks"] += 1
 
+    # epochs a journaled ``failover`` frame announced: the matching
+    # ``epoch`` frame is a fenced standby PROMOTION, not a restart of
+    # the same process (warm-standby HA, master/standby.py)
+    failover_epochs = {
+        int(e["data"].get("new_epoch", 0) or 0)
+        for e in journal_events if e["kind"] == "failover"}
+
     incidents: List[Dict] = []
     for e in journal_events:
         if e["kind"] == "epoch" and int(
                 e["data"].get("epoch", 0) or 0) >= 2:
+            opened = int(e["data"].get("epoch", 0) or 0)
             incidents.append({
-                "kind": "master_restart",
+                "kind": ("failover" if opened in failover_epochs
+                         else "master_restart"),
                 "epoch": e["epoch"], "seq": e["seq"],
                 "t_wall": e["t_wall"],
                 "attributed_state": "degraded",
@@ -437,15 +486,27 @@ def build_narrative(journal_events: List[Dict], ledgers: List[Dict]
     }
 
 
-def assemble_incident(journal_dir: str = "", ckpt_dir: str = "") -> Dict:
+def assemble_incident(journal_dir: str = "", ckpt_dir: str = "",
+                      journal_dirs: Optional[List[str]] = None) -> Dict:
     """The whole incident: merged event stream + narrative + counts.
 
     Pure function of the disk artifacts — the live TimelineQuery verb
     (master/master.py timeline_report) runs THIS on the master's own
     journal dir, so `tools/incident_report.py --journal/--flight` on the
     same artifacts reconstructs byte-equal canonical JSON.
+
+    ``journal_dirs`` lists FURTHER journal dirs to merge after
+    ``journal_dir`` (warm-standby failover post-mortems span the old
+    primary's dir and the promoted standby's); with at most one
+    effective dir the output is byte-identical to the single-journal
+    path.  Live and offline must pass the SAME ordered dir list for
+    byte-equality.
     """
-    journal_events = read_journal_events(journal_dir)
+    dirs: List[str] = []
+    for d in [journal_dir, *(journal_dirs or [])]:
+        if d and d not in dirs:
+            dirs.append(d)
+    journal_events = read_journal_events_multi(dirs)
     flight_events, ledgers = read_flight_events(ckpt_dir)
     events = _merge(journal_events, flight_events)
     traces = sorted({e["trace_id"] for e in events if e["trace_id"]})

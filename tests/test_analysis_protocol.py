@@ -389,6 +389,72 @@ class TestIdemKeyRequired:
         assert found == []
 
 
+# --------------------------------------------- failover-frame durability
+
+
+class TestFailoverDurability:
+    """ISSUE 20: the promotion fence is only real if the ``failover``
+    frame is DURABLE before the new epoch opens — an async append that
+    never gates on the watermark could vanish in a crash and revive a
+    corpse at an unfenced epoch."""
+
+    def test_async_failover_append_flagged(self, tmp_path):
+        found = _scan(tmp_path, "master.py", """\
+            class JobMaster:
+                def promote_to_leader(self):
+                    self.journal.append_nowait(
+                        "failover", {"new_epoch": self.epoch + 2})
+                    self.epoch = self.journal.open_epoch()
+        """)
+        assert [f.checker for f in found] == ["journal-before-ack"]
+        assert "failover" in found[0].message
+        assert "wait_durable" in found[0].message
+
+    def test_sync_failover_append_clean(self, tmp_path):
+        found = _scan(tmp_path, "master.py", """\
+            class JobMaster:
+                def promote_to_leader(self):
+                    self.journal.append(
+                        "failover", {"new_epoch": self.epoch + 2})
+                    self.epoch = self.journal.open_epoch()
+        """)
+        assert found == []
+
+    def test_nowait_gated_on_watermark_clean(self, tmp_path):
+        found = _scan(tmp_path, "master.py", """\
+            class JobMaster:
+                def promote_to_leader(self):
+                    seq = self.journal.append_nowait(
+                        "failover", {"new_epoch": self.epoch + 2})
+                    self.journal.wait_durable(seq)
+                    self.epoch = self.journal.open_epoch()
+        """)
+        assert found == []
+
+    def test_fetch_journal_polling_never_journaled(self, tmp_path):
+        """The shipping pull is POLLING class: a servicer branch that
+        answers FetchJournalRequest WITHOUT journaling is the sanctioned
+        shape (a fetch that journaled would feed the journal it ships —
+        the verb is deliberately absent from JOURNALED_VERBS)."""
+        from dlrover_wuqiong_tpu.analysis.protocol_engine import (
+            IDEM_VERBS,
+            JOURNALED_VERBS,
+        )
+
+        assert "FetchJournalRequest" not in JOURNALED_VERBS
+        assert "FetchJournalRequest" not in IDEM_VERBS
+        found = _scan(tmp_path, "servicer.py", _SERVICER_PREAMBLE + """\
+        def _get(self, node_id, payload):
+            if isinstance(payload, msg.FetchJournalRequest):
+                snap, sseq, frames, durable = self.m.journal.fetch_batch(
+                    payload.from_seq, payload.max_frames)
+                return msg.FetchJournalResponse(frames=frames,
+                                                durable_seq=durable)
+            return None
+""")
+        assert found == []
+
+
 # ------------------------------------------------------- commit-order
 
 

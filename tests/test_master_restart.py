@@ -767,6 +767,132 @@ class TestGoodputAcrossRestart:
         assert m.goodput_summary().wall_s == 7.0
 
 
+# ------------------------------ buffered verbs across a standby FAILOVER
+
+
+class TestAcrossFailover:
+    """ISSUE 20: the degraded-mode buffer and idem keys must behave across
+    a PROMOTION exactly as they do across a same-journal restart — the
+    promoted standby replayed the shipped journal, so original idem keys
+    hit its replayed cache and buffered snapshots still resolve
+    latest-SENT-wins."""
+
+    def _pair(self, tmp_path, ttl=0.5):
+        from dlrover_wuqiong_tpu.master.standby import StandbyTailer
+
+        jd1 = str(tmp_path / "j1")
+        jd2 = str(tmp_path / "j2")
+        m1 = JobMaster(port=0, journal_dir=jd1, lease_ttl_s=ttl)
+        m1.prepare()
+        m1.start_lease_heartbeat()
+        m2 = JobMaster(port=0, journal_dir=jd2, standby=True,
+                       lease_ttl_s=ttl)
+        m2.prepare()
+        tailer = StandbyTailer(m2, f"127.0.0.1:{m1.port}",
+                               lease_ttl_s=ttl, poll_interval_s=0.05)
+        return m1, m2, tailer
+
+    def _mirror_until_leased(self, m1, m2, tailer):
+        # catch the mirror up AND arm the lease clock: promotion is
+        # gated on a lease frame having been ADOPTED (a no-lease
+        # primary makes the standby a pure mirror on purpose)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            tailer.poll_once()
+            if tailer._last_lease_mono and \
+                    m2.journal_stats().durable_seq >= \
+                    m1.journal_stats().durable_seq:  # noqa: SLF001
+                return
+            time.sleep(0.02)
+        raise AssertionError("mirror never caught up / lease never armed")
+
+    def _kill_and_promote(self, m1, mc, tailer):
+        # hard-kill the primary: stop the server AND sever the client's
+        # persistent connection (a real SIGKILL resets the TCP stream;
+        # the in-process server.stop() leaves accepted conns alive)
+        m1._stopped.set()  # noqa: SLF001
+        m1._server.stop()  # noqa: SLF001
+        m1.is_leader = False
+        mc._client.close()  # noqa: SLF001
+        assert tailer.run(threading.Event(), max_seconds=30)
+
+    def test_idem_retry_exactly_once_across_promotion(self, tmp_path):
+        from dlrover_wuqiong_tpu.common.messages import KVStoreAddRequest
+
+        m1, m2, tailer = self._pair(tmp_path)
+        try:
+            mc = MasterClient(
+                f"127.0.0.1:{m1.port},127.0.0.1:{m2.port}", node_id=0)
+            idem = "node0:failover:1"
+            r1 = mc._client.get(  # noqa: SLF001 — fixed idem on purpose
+                KVStoreAddRequest(key="ct", amount=5), idem=idem)
+            assert r1.num == 5
+            self._mirror_until_leased(m1, m2, tailer)
+            old_epoch = m1.epoch
+            self._kill_and_promote(m1, mc, tailer)
+            assert m2.is_leader
+            assert m2.epoch == old_epoch + 2  # fenced above corpse+1
+            # one client-API verb dials over to the new leader (raw
+            # RpcClient calls below deliberately skip that machinery)
+            mc.kv_store_set("dial", b"over")
+            # the retry under the ORIGINAL key crosses the failover:
+            # journaled response replayed on the standby, no re-apply
+            replay = mc._client.get(  # noqa: SLF001
+                KVStoreAddRequest(key="ct", amount=5), idem=idem)
+            assert replay.num == 5
+            assert mc.kv_store_add("ct", 1) == 6  # 5+1, never 10+1
+            assert mc.degraded_stats()["failovers"] >= 1
+            mc.close()
+        finally:
+            tailer.close()
+            m2.stop()
+
+    def test_buffered_drain_latest_sent_wins_across_promotion(
+            self, tmp_path):
+        m1, m2, tailer = self._pair(tmp_path)
+        try:
+            mc = MasterClient(
+                f"127.0.0.1:{m1.port},127.0.0.1:{m2.port}", node_id=0)
+
+            def snap(wall):
+                return {"wall_s": wall,
+                        "states": {"productive": wall * 0.8},
+                        "other_s": 0.0, "goodput_fraction": 0.8}
+
+            mc.report_goodput_ledger(snap(10.0))
+            self._mirror_until_leased(m1, m2, tailer)
+            # kill the primary but do NOT promote yet: the leadership
+            # gap is where buffered verbs park (primary unreachable,
+            # standby still refusing mutations with NotLeaderError)
+            m1._stopped.set()  # noqa: SLF001
+            m1._server.stop()  # noqa: SLF001
+            m1.is_leader = False
+            mc._client.close()  # noqa: SLF001
+            mc.report_goodput_ledger(snap(20.0))
+            mc.report_goodput_ledger(snap(30.0))
+            assert mc.degraded_stats()["pending"] == 2
+            assert tailer.run(threading.Event(), max_seconds=30)
+            assert m2.is_leader
+            # buffered verbs never block on dialing: the first beat
+            # after promotion parks its frame too and ROTATES the
+            # endpoint (pending 2 -> 3) ...
+            mc.report_goodput_ledger(snap(40.0))
+            assert mc.degraded_stats()["pending"] == 3
+            # ... so the next beat lands inline on the new leader
+            # FIRST and the older buffered frames drain BEHIND it —
+            # exactly the arrival-order hazard latest-SENT-wins absorbs
+            mc.report_goodput_ledger(snap(50.0))
+            assert mc.degraded_stats()["pending"] == 0
+            s = m2.goodput_summary()
+            assert s.nodes == 1
+            assert s.wall_s == 50.0  # latest-SENT cumulative wins
+            assert mc.degraded_stats()["failovers"] >= 1
+            mc.close()
+        finally:
+            tailer.close()
+            m2.stop()
+
+
 # --------------------------------- policy decisions across a master restart
 
 

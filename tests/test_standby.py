@@ -1,0 +1,384 @@
+"""Warm-standby master (ISSUE 20): journal shipping + fenced failover.
+
+Layers under test, bottom up:
+
+- `MasterJournal.fetch_batch` / `ingest_snapshot` / `ingest_frames` —
+  the shipping plane: durable-only frames, verbatim bytes (the mirror
+  is a byte-prefix of the primary's log), snapshot+tail handoff when
+  compaction outruns the ring, whole-frames-only ingest (torn batch
+  tails and gaps stop, never corrupt).
+- `StandbyTailer` — fetch→ingest→fold via the SAME `_apply_entry`
+  replay path, lease clock armed only by adopted lease frames, final
+  drain that DISARMS when a fresh lease proves the primary alive.
+- The failover ladder end to end, in-process: promotion fenced at
+  observed+2, exactly-once idem replay across the bump, the corpse
+  self-fencing read-only via --peer, and the live-vs-offline merged
+  incident timeline byte-equal with kind="failover".
+
+The chaos `master-failover` drill runs the same ladder with real
+processes and SIGKILL; these stay fast and deterministic.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from dlrover_wuqiong_tpu.agent.master_client import MasterClient
+from dlrover_wuqiong_tpu.common import serialize
+from dlrover_wuqiong_tpu.common.comm import RpcClient, RpcError
+from dlrover_wuqiong_tpu.common.messages import (
+    FetchJournalRequest,
+    JournalStatsQuery,
+    KVStoreAddRequest,
+    KVStoreSetRequest,
+)
+from dlrover_wuqiong_tpu.master.journal import MasterJournal
+from dlrover_wuqiong_tpu.master.master import JobMaster
+from dlrover_wuqiong_tpu.master.standby import StandbyTailer
+from dlrover_wuqiong_tpu.telemetry import timeline as tl
+
+
+# ------------------------------------------------------- shipping plane
+
+
+def _mkjournal(tmp_path, name):
+    j = MasterJournal(str(tmp_path / name))
+    j.load()
+    return j
+
+
+def _raw_lines(journal):
+    with open(journal._path, "rb") as f:  # noqa: SLF001
+        return [l for l in f.read().split(b"\n") if l.strip()]
+
+
+class TestFetchBatch:
+    def test_ring_serves_durable_frames_verbatim(self, tmp_path):
+        j = _mkjournal(tmp_path, "src")
+        for i in range(3):
+            j.append("kv", {"key": f"k{i}"})
+        snap, snap_seq, frames, durable = j.fetch_batch(0)
+        assert (snap, snap_seq) == (b"", 0)
+        assert durable == 3
+        assert frames == _raw_lines(j)  # verbatim bytes, not re-encoded
+        # caught-up pull: nothing to ship, watermark stays
+        assert j.fetch_batch(3)[2] == []
+        st = j.group_commit_stats()
+        assert st["shipped_seq"] == 3
+        assert st["standby_lag_frames"] == 0
+        j.close()
+
+    def test_unfetched_journal_reports_no_standby(self, tmp_path):
+        j = _mkjournal(tmp_path, "src")
+        j.append("kv", {})
+        assert j.group_commit_stats()["standby_lag_frames"] == -1
+        j.close()
+
+    def test_max_frames_paginates(self, tmp_path):
+        j = _mkjournal(tmp_path, "src")
+        for i in range(5):
+            j.append("kv", {"i": i})
+        _, _, page1, durable = j.fetch_batch(0, max_frames=2)
+        assert len(page1) == 2 and durable == 5
+        next_seq = int(serialize.loads(page1[-1])["seq"])
+        _, _, page2, _ = j.fetch_batch(next_seq, max_frames=10)
+        assert len(page2) == 3
+
+    def test_snapshot_tail_handoff_when_ring_outrun(self, tmp_path):
+        j = _mkjournal(tmp_path, "src")
+        for i in range(4):
+            j.append("kv", {"i": i})
+        j.snapshot({"kv": {"x": 1}})
+        j.append("kv", {"i": 99})  # tail after compaction
+        j._ship_ring.clear()  # noqa: SLF001 — emulate a long-dead standby
+        snap, snap_seq, frames, durable = j.fetch_batch(0)
+        assert snap and snap_seq > 0
+        state = serialize.loads(snap).get("state")
+        assert state["kv"] == {"x": 1}
+        # tail resumes past the snapshot: compaction marker + the new kv
+        seqs = [int(serialize.loads(f)["seq"]) for f in frames]
+        assert seqs == list(range(snap_seq + 1, durable + 1))
+        j.close()
+
+    def test_handoff_skips_snapshot_standby_already_covers(self, tmp_path):
+        j = _mkjournal(tmp_path, "src")
+        for i in range(3):
+            j.append("kv", {"i": i})
+        j.snapshot({"kv": {}})
+        j.append("kv", {"i": 3})
+        j._ship_ring.clear()  # noqa: SLF001
+        # the standby already holds past the snapshot seq: no handoff
+        snap, snap_seq, frames, _ = j.fetch_batch(4)
+        assert (snap, snap_seq) == (b"", 0)
+        assert len(frames) >= 1
+        j.close()
+
+
+class TestIngest:
+    def test_mirror_is_byte_prefix_of_primary(self, tmp_path):
+        src = _mkjournal(tmp_path, "src")
+        dst = _mkjournal(tmp_path, "dst")
+        for i in range(4):
+            src.append("kv", {"i": i})
+        _, _, frames, _ = src.fetch_batch(0)
+        adopted = dst.ingest_frames(frames)
+        assert [f["seq"] for f in adopted] == [1, 2, 3, 4]
+        assert _raw_lines(dst) == _raw_lines(src)
+        assert dst.group_commit_stats()["durable_seq"] == 4
+        src.close()
+        dst.close()
+
+    def test_torn_batch_tail_whole_frames_only(self, tmp_path):
+        src = _mkjournal(tmp_path, "src")
+        dst = _mkjournal(tmp_path, "dst")
+        for i in range(3):
+            src.append("kv", {"i": i})
+        _, _, frames, _ = src.fetch_batch(0)
+        torn = frames[:2] + [frames[2][:10]]  # mid-frame cut
+        adopted = dst.ingest_frames(torn)
+        assert [f["seq"] for f in adopted] == [1, 2]
+        # the local log holds ONLY intact frames; a re-fetch from our
+        # durable seq resumes cleanly (dup skipped upstream by from_seq)
+        assert _raw_lines(dst) == frames[:2]
+        adopted = dst.ingest_frames(frames[2:])
+        assert [f["seq"] for f in adopted] == [3]
+        assert _raw_lines(dst) == frames
+        src.close()
+        dst.close()
+
+    def test_gap_stops_ingest_and_refetch_heals(self, tmp_path):
+        src = _mkjournal(tmp_path, "src")
+        dst = _mkjournal(tmp_path, "dst")
+        for i in range(4):
+            src.append("kv", {"i": i})
+        _, _, frames, _ = src.fetch_batch(0)
+        adopted = dst.ingest_frames([frames[0], frames[2], frames[3]])
+        assert [f["seq"] for f in adopted] == [1]  # gap at 3: stop
+        adopted = dst.ingest_frames(frames)  # re-fetch overlap
+        assert [f["seq"] for f in adopted] == [2, 3, 4]
+        src.close()
+        dst.close()
+
+    def test_ingest_snapshot_resets_log_and_primes_seq(self, tmp_path):
+        src = _mkjournal(tmp_path, "src")
+        dst = _mkjournal(tmp_path, "dst")
+        dst.append("stale", {"old": True})  # pre-handoff garbage
+        for i in range(3):
+            src.append("kv", {"i": i})
+        src.snapshot({"kv": {"a": 1}})
+        src.append("kv", {"i": 9})
+        src._ship_ring.clear()  # noqa: SLF001
+        snap, snap_seq, frames, _ = src.fetch_batch(0)
+        state, seq, _epoch = dst.ingest_snapshot(snap)
+        assert state["kv"] == {"a": 1}
+        assert seq == snap_seq
+        assert _raw_lines(dst) == []  # local log reset
+        adopted = dst.ingest_frames(frames)
+        assert adopted and adopted[-1]["kind"] == "kv"
+        assert dst.group_commit_stats()["durable_seq"] == \
+            src.group_commit_stats()["durable_seq"]
+        src.close()
+        dst.close()
+
+
+# ------------------------------------------------- tailer + failover e2e
+
+
+def _hard_kill(master, client=None):
+    """In-process stand-in for SIGKILL: stop the server, mark the
+    leadership dead, and sever the client's persistent connection (a
+    real process death resets the TCP stream; socketserver's stop only
+    closes the accept loop)."""
+    master._stopped.set()  # noqa: SLF001
+    master._server.stop()  # noqa: SLF001
+    master.is_leader = False
+    if client is not None:
+        client._client.close()  # noqa: SLF001
+
+
+@pytest.fixture()
+def ha_pair(tmp_path):
+    """Primary (leased) + standby + armed tailer, torn down in order."""
+    ttl = 0.5
+    m1 = JobMaster(port=0, journal_dir=str(tmp_path / "j1"),
+                   lease_ttl_s=ttl)
+    m1.prepare()
+    m1.start_lease_heartbeat()
+    m2 = JobMaster(port=0, journal_dir=str(tmp_path / "j2"),
+                   standby=True, lease_ttl_s=ttl)
+    m2.prepare()
+    tailer = StandbyTailer(m2, f"127.0.0.1:{m1.port}", lease_ttl_s=ttl,
+                           poll_interval_s=0.05)
+    yield m1, m2, tailer
+    tailer.close()
+    m2.stop()
+    m1.stop()
+
+
+def _mirror_until_leased(m1, m2, tailer):
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        tailer.poll_once()
+        if tailer._last_lease_mono and \
+                m2.journal_stats().durable_seq >= \
+                m1.journal_stats().durable_seq:  # noqa: SLF001
+            return
+        time.sleep(0.02)
+    raise AssertionError("mirror never caught up / lease never armed")
+
+
+class TestStandbyTailer:
+    def test_mirror_folds_state_and_reports_lag(self, ha_pair):
+        m1, m2, tailer = ha_pair
+        mc = MasterClient(f"127.0.0.1:{m1.port}", node_id=0)
+        mc.kv_store_set("boot", b"coord")
+        assert mc.kv_store_add("ctr", 2) == 2
+        _mirror_until_leased(m1, m2, tailer)
+        s1, s2 = m1.journal_stats(), m2.journal_stats()
+        assert s1.standby_lag_frames == 0
+        assert s1.shipped_seq == s1.durable_seq
+        assert s2.epoch == s1.epoch  # mirrored, no spurious bump
+        assert not s2.is_leader and s1.is_leader
+        # folded through the SAME apply path: state queryable read-only
+        mc_sb = MasterClient(f"127.0.0.1:{m2.port}", node_id=1)
+        assert mc_sb.kv_store_get("boot") == b"coord"
+        mc_sb.close()
+        mc.close()
+
+    def test_standby_refuses_mutations_until_promoted(self, ha_pair):
+        m1, m2, tailer = ha_pair
+        rc = RpcClient(f"127.0.0.1:{m2.port}", node_id=7, retries=1)
+        with pytest.raises(RpcError, match="NotLeaderError"):
+            rc.get(KVStoreSetRequest(key="nope", value=b"x"))
+        # read-only verbs answer (a fenced master is still a reporter)
+        assert rc.get(JournalStatsQuery()).is_leader is False
+        rc.close()
+
+    def test_fetch_journal_is_never_journaled(self, ha_pair):
+        """The POLLING fetch verb must not feed the journal it ships —
+        N idle polls leave the primary's seq exactly flat."""
+        m1, m2, tailer = ha_pair
+        _mirror_until_leased(m1, m2, tailer)
+        before = m1.journal_stats().durable_seq
+        for _ in range(5):
+            assert tailer.poll_once() == 0
+        assert m1.journal_stats().durable_seq == before
+
+    def test_no_lease_primary_makes_pure_mirror(self, tmp_path):
+        """fleet_bench's shape: primary never heartbeats a lease, so the
+        standby mirrors forever and NEVER promotes (ttl clock unarmed)."""
+        m1 = JobMaster(port=0, journal_dir=str(tmp_path / "j1"))
+        m1.prepare()
+        m2 = JobMaster(port=0, journal_dir=str(tmp_path / "j2"),
+                       standby=True, lease_ttl_s=0.2)
+        m2.prepare()
+        tailer = StandbyTailer(m2, f"127.0.0.1:{m1.port}",
+                               lease_ttl_s=0.2, poll_interval_s=0.02)
+        try:
+            mc = MasterClient(f"127.0.0.1:{m1.port}", node_id=0)
+            mc.kv_store_set("k", b"v")
+            mc.close()
+            assert not tailer.run(threading.Event(), max_seconds=1.0)
+            assert not m2.is_leader
+            assert m2.journal_stats().durable_seq == \
+                m1.journal_stats().durable_seq
+        finally:
+            tailer.close()
+            m2.stop()
+            m1.stop()
+
+    def test_fresh_lease_mid_drain_disarms(self, ha_pair):
+        """A stalled tailer whose clock reads expired must NOT promote
+        while the primary still heartbeats — the final drain adopts a
+        fresh lease frame and disarms."""
+        m1, m2, tailer = ha_pair
+        _mirror_until_leased(m1, m2, tailer)
+        # forge expiry: pretend the last lease landed long ago
+        tailer._last_lease_mono = (  # noqa: SLF001
+            time.monotonic() - 10 * tailer.lease_ttl_s)
+        time.sleep(tailer.lease_ttl_s)  # let the primary heartbeat
+        assert not tailer.run(threading.Event(), max_seconds=1.5)
+        assert not m2.is_leader
+        assert m1.is_leader
+
+
+class TestFailover:
+    def test_promotion_fence_exactly_once_and_corpse(self, ha_pair,
+                                                     tmp_path):
+        m1, m2, tailer = ha_pair
+        mc = MasterClient(f"127.0.0.1:{m1.port},127.0.0.1:{m2.port}",
+                          node_id=0)
+        mc.report_dataset_shard_params(
+            batch_size=4, dataset_size=64, dataset_name="ds",
+            num_minibatches_per_shard=2)
+        t1 = mc.get_task("ds")
+        mc.kv_store_set("boot", b"coord")
+        idem = "node0:add:1"
+        assert mc._client.get(  # noqa: SLF001 — fixed idem on purpose
+            KVStoreAddRequest(key="ctr", amount=5), idem=idem).num == 5
+        _mirror_until_leased(m1, m2, tailer)
+
+        old_epoch = m1.epoch
+        _hard_kill(m1, mc)
+        assert tailer.run(threading.Event(), max_seconds=30)
+
+        # fenced promotion: strictly above what a revived corpse's
+        # naive restart bump (+1) could ever reach
+        assert m2.is_leader
+        assert m2.epoch == old_epoch + 2
+
+        # client fails over on its next critical verb; state intact
+        t2 = mc.get_task("ds")
+        assert t2.task_id != t1.task_id  # dispatch cursor exact
+        assert mc.kv_store_get("boot") == b"coord"
+        assert mc.degraded_stats()["failovers"] >= 1
+        # exactly-once: the original idem key replays the journaled
+        # response instead of re-applying
+        assert mc._client.get(  # noqa: SLF001
+            KVStoreAddRequest(key="ctr", amount=5), idem=idem).num == 5
+        assert mc.kv_store_add("ctr", 1) == 6
+
+        # the corpse revives on its old journal with --peer: it must
+        # observe the higher epoch and self-fence read-only
+        m3 = JobMaster(port=0, journal_dir=str(tmp_path / "j1"),
+                       peer=f"127.0.0.1:{m2.port}", lease_ttl_s=0.5)
+        m3.prepare()
+        rc = RpcClient(f"127.0.0.1:{m3.port}", node_id=9, retries=1)
+        try:
+            assert not m3.is_leader
+            assert m3.epoch < m2.epoch
+            with pytest.raises(RpcError, match="NotLeaderError"):
+                rc.get(KVStoreAddRequest(key="q", amount=1))
+        finally:
+            rc.close()
+            m3.stop()
+            mc.close()
+
+    def test_timeline_merges_both_journals_byte_equal(self, ha_pair,
+                                                      tmp_path):
+        m1, m2, tailer = ha_pair
+        jd1, jd2 = str(tmp_path / "j1"), str(tmp_path / "j2")
+        mc = MasterClient(f"127.0.0.1:{m1.port},127.0.0.1:{m2.port}",
+                          node_id=0)
+        mc.kv_store_set("k", b"v")
+        _mirror_until_leased(m1, m2, tailer)
+        _hard_kill(m1, mc)
+        assert tailer.run(threading.Event(), max_seconds=30)
+        mc.kv_store_set("after", b"failover")
+
+        resp = mc.get_timeline(journal_dirs=[jd2, jd1])
+        offline = tl.incident_json(tl.assemble_incident(
+            journal_dir=jd2, ckpt_dir="", journal_dirs=[jd1]))
+        assert resp.content == offline  # live == offline, byte-equal
+        rep = json.loads(offline)
+        kinds = [i["kind"] for i in rep["narrative"]["incidents"]]
+        assert "failover" in kinds
+        # the merge dedups shipped frames: (epoch, seq, kind) unique and
+        # (epoch, seq)-ordered across both dirs
+        keys = [(e["epoch"], e["seq"], e["kind"]) for e in rep["events"]
+                if e["source"] == "journal"]
+        assert keys == sorted(keys, key=lambda k: k[:2])
+        assert len(keys) == len(set(keys))
+        mc.close()

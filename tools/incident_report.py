@@ -3,16 +3,25 @@
 Two sources, ONE byte-identical timeline (telemetry/timeline.py):
 
     python tools/incident_report.py [--addr HOST:PORT] [--ckpt DIR]
-    python tools/incident_report.py --journal DIR [--flight CKPT_DIR]
+    python tools/incident_report.py --journal DIR[,DIR2] [--flight CKPT_DIR]
 
 Live mode asks the master (TimelineQuery, POLLING class) to assemble
 the incident timeline from its own journal directory plus the flight
 dumps under ``--ckpt`` (falls back to ``--flight`` when only that is
-given).  Offline mode runs the SAME assembler over disk artifacts
+given), and folds the journal-shipping gauges (shipped_seq,
+standby_lag_frames, lease_epoch — get_journal_stats) into the summary
+line.  Offline mode runs the SAME assembler over disk artifacts
 alone — a post-mortem needs no process alive.  Because the assembler
 is a pure function of the artifacts, the two sources produce
 byte-equal canonical JSON; ``timeline_sha256`` in the summary line is
 the proof handle (the chaos drills diff it across live/offline).
+
+``--journal`` accepts a comma-separated dir list for warm-standby
+failover post-mortems (old primary's dir + promoted standby's): both
+journals merge in (epoch, seq) order with byte-identical shipped
+frames deduped.  Pass the SAME ordered list to live mode (the
+answering master's own dir sorts first either way) and the two
+timelines stay byte-equal across the failover.
 
 Optional sinks (paths, both write full artifacts next to the 1-line
 summary): ``--events-out FILE`` writes the canonical incident JSON;
@@ -52,6 +61,8 @@ def _summarize(content: str, src: dict) -> dict:
         "epochs": len(counts.get("epochs", [])),
         "processes": len(counts.get("processes", [])),
         "incidents": len(incidents),
+        "failovers": sum(1 for i in incidents
+                         if i.get("kind") == "failover"),
         "lost_s": round(sum(float(i.get("lost_s", 0.0))
                             for i in incidents), 3),
         "goodput_fraction": narr.get("goodput_fraction"),
@@ -73,21 +84,29 @@ def _sinks(content: str, vals: dict) -> None:
         export_perfetto(json.loads(content), perf)
 
 
+def _journal_dirs(vals: dict) -> list:
+    return [d.strip() for d in (vals.get("--journal") or "").split(",")
+            if d.strip()]
+
+
 def _from_disk(vals: dict) -> dict:
     from dlrover_wuqiong_tpu.telemetry import assemble_incident, incident_json
 
-    journal = vals.get("--journal") or ""
+    dirs = _journal_dirs(vals)
     flight = vals.get("--flight") or ""
-    if journal and not os.path.isdir(journal):
-        raise FileNotFoundError(
-            f"--journal: {journal!r} is not a directory")
+    for d in dirs:
+        if not os.path.isdir(d):
+            raise FileNotFoundError(
+                f"--journal: {d!r} is not a directory")
     if flight and not os.path.isdir(flight):
         raise FileNotFoundError(
             f"--flight: {flight!r} is not a directory")
-    content = incident_json(assemble_incident(journal_dir=journal,
-                                              ckpt_dir=flight))
+    content = incident_json(assemble_incident(
+        journal_dir=dirs[0] if dirs else "", ckpt_dir=flight,
+        journal_dirs=dirs[1:]))
     _sinks(content, vals)
-    return _summarize(content, {"source": "disk", "journal_dir": journal,
+    return _summarize(content, {"source": "disk",
+                                "journal_dir": ",".join(dirs),
                                 "ckpt_dir": flight})
 
 
@@ -97,12 +116,22 @@ def _from_master(addr: str, vals: dict) -> dict:
     ckpt = vals.get("--ckpt") or vals.get("--flight") or ""
     mc = MasterClient(addr, node_id=-1)
     try:
-        resp = mc.get_timeline(ckpt_dir=ckpt)
+        resp = mc.get_timeline(ckpt_dir=ckpt,
+                               journal_dirs=_journal_dirs(vals))
+        try:
+            stats = mc.get_journal_stats()
+            gauges = {"shipped_seq": stats.shipped_seq,
+                      "standby_lag_frames": stats.standby_lag_frames,
+                      "lease_epoch": stats.lease_epoch,
+                      "is_leader": stats.is_leader}
+        except Exception:  # noqa: BLE001 — gauges are best-effort garnish;
+            # the timeline answer is the deliverable
+            gauges = {}
     finally:
         mc.close()
     _sinks(resp.content, vals)
     return _summarize(resp.content, {"source": "master", "addr": addr,
-                                     "ckpt_dir": ckpt})
+                                     "ckpt_dir": ckpt, **gauges})
 
 
 def main(argv=None) -> int:

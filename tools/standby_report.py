@@ -1,0 +1,69 @@
+"""Warm-standby / journal-shipping report: ONE JSON line for the operator.
+
+    python tools/standby_report.py --addr HOST:PORT
+
+Polls `get_journal_stats` (POLLING class, read-only — a standby and a
+fenced corpse both answer it) and prints the leadership + shipping
+gauges grown by ISSUE 20: who believes it is leader, the fencing and
+lease epochs, the durable-seq watermark, how far a standby's mirror
+trails it (``standby_lag_frames`` is -1 until a standby's first fetch),
+and the journal's group-commit shape for context.
+
+Point it at EITHER master of an HA pair: the primary reports the lag of
+whoever tails it; a standby reports its own mirror's watermark (its
+``shipped_seq`` gauges whoever might tail *it*, normally none).  After a
+failover, the promoted standby answers ``is_leader: true`` with the
+bumped epoch and the revived corpse answers ``is_leader: false`` — the
+split-brain check is one invocation against each address.
+
+Exit/error contract matches the other report tools
+(common/report_cli.py): one JSON line ALWAYS, rc=2 missing address,
+rc=1 failure, rc=0 success.  No offline mode — lag is a property of two
+live processes; post-mortems use tools/incident_report.py over the
+journal dirs instead.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _from_master(addr: str, vals: dict) -> dict:
+    from dlrover_wuqiong_tpu.agent.master_client import MasterClient
+
+    mc = MasterClient(addr, node_id=-1)
+    try:
+        s = mc.get_journal_stats()
+    finally:
+        mc.close()
+    return {
+        "source": "master", "addr": addr,
+        "enabled": s.enabled,
+        "is_leader": s.is_leader,
+        "epoch": s.epoch,
+        "lease_epoch": s.lease_epoch,
+        "durable_seq": s.durable_seq,
+        "shipped_seq": s.shipped_seq,
+        "standby_lag_frames": s.standby_lag_frames,
+        "group_commit": s.group_commit,
+        "batches": s.batches,
+        "frames": s.frames,
+    }
+
+
+def main(argv=None) -> int:
+    from dlrover_wuqiong_tpu.common.report_cli import run_report
+
+    return run_report(
+        argv, __doc__,
+        offline=lambda v: None,
+        live=_from_master,
+        no_addr_error="no master address: pass --addr or set "
+                      "DWT_MASTER_ADDR (standby lag is a live gauge; "
+                      "post-mortems: tools/incident_report.py --journal)")
+
+
+if __name__ == "__main__":
+    sys.exit(main())
